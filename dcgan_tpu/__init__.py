@@ -1,0 +1,22 @@
+"""dcgan_tpu — a TPU-native (JAX/XLA/pjit) framework for distributed GAN training.
+
+Built from scratch with the capabilities of the reference
+`tiantengfei/Distributed-tensorflow-for-DCGAN` (an async parameter-server DCGAN
+trainer, see /root/repo/SURVEY.md), re-designed TPU-first:
+
+- pure-functional ops/models (init/apply over pytrees) compiled by XLA onto the MXU,
+- synchronous data parallelism via `jax.sharding.Mesh` + `jit` with `NamedSharding`
+  (gradient all-reduce and cross-replica BatchNorm ride ICI collectives inserted by
+  GSPMD) instead of the reference's gRPC parameter-server pulls/pushes
+  (reference: image_train.py:55-67, distriubted_model.py:70),
+- a host-side sharded TFRecord loader with device prefetch (native C++ reader)
+  instead of queue runners (reference: image_input.py),
+- functional BatchNorm EMA state instead of hidden ExponentialMovingAverage
+  side-state (reference: distriubted_model.py:15-52),
+- checkpoint/resume, metric writing, and fixed-z sample grids as first-class
+  subsystems (reference: image_train.py:103-194).
+"""
+
+__version__ = "0.1.0"
+
+from dcgan_tpu.config import ModelConfig, TrainConfig  # noqa: F401

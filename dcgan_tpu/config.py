@@ -1,0 +1,132 @@
+"""Configuration dataclasses — the single flat knob namespace of the reference
+(`image_train.py:10-38` tf.app.flags) re-expressed as typed, validated dataclasses.
+
+Unlike the reference, model hyperparameters here are *wired*: changing
+`ModelConfig.batch_size`/`output_size`/`c_dim` actually changes the model (the
+reference's flags of the same names were disconnected from the module constants
+actually used — SURVEY.md §2.4 #8, distriubted_model.py:7-12 vs image_train.py:15-18).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """DCGAN architecture knobs (reference: distriubted_model.py:7-12, image_train.py:42).
+
+    The reference hard-codes output_size=64, gf_dim=df_dim=64, c_dim=3, z_dim=100.
+    Here output_size may be any power of two >= 8; the G/D stacks deepen
+    automatically (128x128 config from BASELINE.json uses output_size=128).
+    """
+
+    output_size: int = 64          # spatial size of generated images (H == W)
+    gf_dim: int = 64               # generator base feature maps
+    df_dim: int = 64               # discriminator base feature maps
+    c_dim: int = 3                 # image channels
+    z_dim: int = 100               # latent dimension (image_train.py:42)
+    num_classes: int = 0           # >0 activates class-conditional G/D (the
+                                   # reference's dead `y` arg, distriubted_model.py:83)
+    base_size: int = 4             # spatial size of the first feature map
+    bn_momentum: float = 0.9       # EMA decay (distriubted_model.py:18,23)
+    bn_eps: float = 1e-5           # (distriubted_model.py:18)
+    leak: float = 0.2              # lrelu slope (distriubted_model.py:156)
+    kernel_size: int = 5           # conv / deconv kernel (distriubted_model.py:176,190)
+    compute_dtype: str = "bfloat16"  # MXU-native compute precision
+    param_dtype: str = "float32"     # parameter / BN-stat storage precision
+
+    def __post_init__(self):
+        n = self.num_up_layers
+        if n < 1 or self.base_size * (2 ** n) != self.output_size:
+            raise ValueError(
+                f"output_size={self.output_size} must be base_size*2^k with "
+                f"k >= 1 (base_size={self.base_size})")
+
+    @property
+    def num_up_layers(self) -> int:
+        """Number of stride-2 deconv (G) / conv (D) stages.
+
+        output_size 64 -> 4 stages (matching the reference's fixed 4-deconv stack,
+        distriubted_model.py:93-109); 128 -> 5 stages.
+        """
+        return int(round(math.log2(self.output_size / self.base_size)))
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh topology. Replaces ClusterSpec/Server/ps-role entirely
+    (reference: image_train.py:52-67) — there is no parameter-server process;
+    parameters are replicated (or model-sharded) per the sharding rules and
+    gradients all-reduce over ICI.
+    """
+
+    data: int = -1                 # data-parallel axis size; -1 = all devices
+    model: int = 1                 # tensor-parallel axis size (latent; 1 = off)
+
+    def axis_sizes(self, n_devices: int) -> Tuple[int, int]:
+        model = max(1, self.model)
+        if self.data > 0:
+            data = self.data
+        else:
+            if n_devices % model != 0:
+                raise ValueError(
+                    f"model axis {model} does not divide {n_devices} devices")
+            data = n_devices // model
+        if data * model != n_devices:
+            raise ValueError(
+                f"mesh {data}x{model} does not cover {n_devices} devices")
+        return data, model
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    """Run knobs — same set as the reference's flags (image_train.py:10-38) plus
+    the defect-fix gates from SURVEY.md §2.4.
+    """
+
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+
+    # Optimization (image_train.py:11-13,109-112)
+    learning_rate: float = 2e-4
+    beta1: float = 0.5
+    batch_size: int = 64           # global batch (sharded over the data axis)
+    max_steps: int = 1_200_000     # (image_train.py:150)
+    loss: str = "gan"              # "gan" (BCE, image_train.py:91-96) | "wgan-gp"
+    gp_weight: float = 10.0        # WGAN-GP gradient-penalty coefficient
+    update_mode: str = "sequential"  # "sequential": D step then G step (intended
+                                     # semantics); "fused": both grads from the same
+                                     # params, applied together (reference parity,
+                                     # SURVEY.md §2.4 #2, image_train.py:156-158)
+
+    # Data (image_input.py:11-16, image_train.py:19-26)
+    data_dir: str = "train"
+    sample_image_dir: str = "sample_data"
+    dataset: str = "celebA"
+    shuffle_buffer: int = 10_776   # 10% of epoch (image_input.py:134-136)
+    num_loader_threads: int = 16   # (image_input.py:77)
+    normalize_inputs: bool = True  # map reals to [-1,1]; the reference never does
+                                   # (SURVEY.md §2.4 #1) — set False for strict parity
+    record_dtype: str = "float64"  # on-disk pixel dtype (image_input.py:48)
+
+    # Observability (image_train.py:37,129,179)
+    checkpoint_dir: str = "checkpoint"
+    sample_dir: str = "samples"
+    save_summaries_secs: float = 10.0
+    save_model_secs: float = 600.0
+    sample_every_steps: int = 100
+    sample_grid: Tuple[int, int] = (8, 8)   # 8x8 grid (image_train.py:205)
+    log_every_steps: int = 1
+
+    # Misc
+    seed: int = 0
+    sample_size: int = 64          # fixed-z sample batch (image_train.py:43)
+
+    def __post_init__(self):
+        if self.loss not in ("gan", "wgan-gp"):
+            raise ValueError(f"unknown loss {self.loss!r}")
+        if self.update_mode not in ("sequential", "fused"):
+            raise ValueError(f"unknown update_mode {self.update_mode!r}")

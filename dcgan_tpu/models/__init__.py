@@ -1,0 +1,10 @@
+"""Model zoo: DCGAN generator / discriminator / sampler (+ conditional variant)."""
+
+from dcgan_tpu.models.dcgan import (  # noqa: F401
+    discriminator_apply,
+    discriminator_init,
+    gan_init,
+    generator_apply,
+    generator_init,
+    sampler_apply,
+)

@@ -1,0 +1,219 @@
+"""DCGAN generator / discriminator / sampler as pure init/apply functions.
+
+Capability-parity targets in the reference (behavior matched, architecture
+re-designed functional — nothing is copied):
+
+- `generator(z)`  distriubted_model.py:83-111 — linear z -> gf*8*4*4, reshape to
+  [B,4,4,gf*8], then stride-2 5x5 deconv stages through gf*{4,2,1} with BN+relu,
+  final deconv to c_dim + tanh. Batch size was hard-coded in every output_shape
+  (distriubted_model.py:93-109); here shapes follow the input batch.
+- `discriminator(image, reuse)`  distriubted_model.py:114-128 — stride-2 5x5 conv
+  stages through df*{1,2,4,8}, BN on all but stage 0, lrelu(0.2), flatten,
+  linear -> 1 logit; returns (sigmoid(logit), logit). TF's `reuse=True` variable
+  sharing is simply passing the same params pytree — no variable scopes exist.
+- `sampler(z)`  distriubted_model.py:131-153 — generator with train=False BN
+  (running EMA statistics). Here that's `generator_apply(..., train=False)` on
+  explicit state rather than TF side-state (SURVEY.md §2.4 #9).
+
+Extensions beyond the reference (BASELINE.json configs):
+- output_size 128 (or any base_size*2^k) deepens both stacks automatically;
+- num_classes > 0 activates class conditioning (the reference's `y` argument is
+  accepted-but-ignored, distriubted_model.py:83 / SURVEY.md §2.4 #7): one-hot
+  labels concat onto z for G and broadcast as constant channel maps onto the
+  image for D.
+
+Params/state are plain nested dicts so `jax.tree_util` / optax / checkpointing
+all work without a framework dependency.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from dcgan_tpu.config import ModelConfig
+from dcgan_tpu.ops.layers import (
+    conv2d_apply,
+    conv2d_init,
+    deconv2d_apply,
+    deconv2d_init,
+    linear_apply,
+    linear_init,
+    lrelu,
+)
+from dcgan_tpu.ops.norm import batch_norm_apply, batch_norm_init
+
+Pytree = dict
+
+
+def _dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.param_dtype)
+
+
+def _cdtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.compute_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Generator
+# ---------------------------------------------------------------------------
+
+def generator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
+    """Returns (params, bn_state) for the generator."""
+    k = cfg.num_up_layers
+    dtype = _dtype(cfg)
+    keys = jax.random.split(key, 2 * k + 2)
+
+    in_dim = cfg.z_dim + (cfg.num_classes if cfg.num_classes else 0)
+    top_ch = cfg.gf_dim * (2 ** (k - 1))
+    params: Pytree = {
+        "proj": linear_init(keys[0], in_dim, top_ch * cfg.base_size * cfg.base_size,
+                            dtype=dtype),
+    }
+    state: Pytree = {}
+    bn_p, bn_s = batch_norm_init(keys[1], top_ch, dtype=dtype)
+    params["bn0"], state["bn0"] = bn_p, bn_s
+
+    in_ch = top_ch
+    for i in range(1, k + 1):
+        out_ch = cfg.c_dim if i == k else cfg.gf_dim * (2 ** (k - 1 - i))
+        params[f"deconv{i}"] = deconv2d_init(
+            keys[2 * i], in_ch, out_ch, kernel=cfg.kernel_size, dtype=dtype)
+        if i < k:
+            bn_p, bn_s = batch_norm_init(keys[2 * i + 1], out_ch, dtype=dtype)
+            params[f"bn{i}"], state[f"bn{i}"] = bn_p, bn_s
+        in_ch = out_ch
+    return params, state
+
+
+def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
+                    cfg: ModelConfig, train: bool,
+                    labels: Optional[jax.Array] = None,
+                    axis_name: Optional[str] = None
+                    ) -> Tuple[jax.Array, Pytree]:
+    """z [B, z_dim] (-1..1) -> image [B, S, S, c_dim] in tanh range.
+
+    train=True uses batch BN statistics and returns updated EMA state;
+    train=False is the reference's `sampler` path (running stats, state
+    unchanged).
+    """
+    k = cfg.num_up_layers
+    cdt = _cdtype(cfg)
+    new_state: Pytree = {}
+
+    if cfg.num_classes:
+        if labels is None:
+            raise ValueError("conditional generator requires labels")
+        onehot = jax.nn.one_hot(labels, cfg.num_classes, dtype=z.dtype)
+        z = jnp.concatenate([z, onehot], axis=-1)
+
+    top_ch = cfg.gf_dim * (2 ** (k - 1))
+    h = linear_apply(params["proj"], z.astype(cdt), compute_dtype=cdt)
+    h = h.reshape(-1, cfg.base_size, cfg.base_size, top_ch)
+    h, new_state["bn0"] = batch_norm_apply(
+        params["bn0"], state["bn0"], h, train=train,
+        momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name)
+    h = jax.nn.relu(h)
+
+    for i in range(1, k + 1):
+        h = deconv2d_apply(params[f"deconv{i}"], h, compute_dtype=cdt)
+        if i < k:
+            h, new_state[f"bn{i}"] = batch_norm_apply(
+                params[f"bn{i}"], state[f"bn{i}"], h, train=train,
+                momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name)
+            h = jax.nn.relu(h)
+
+    return jnp.tanh(h.astype(jnp.float32)), new_state
+
+
+def sampler_apply(params: Pytree, state: Pytree, z: jax.Array, *,
+                  cfg: ModelConfig,
+                  labels: Optional[jax.Array] = None) -> jax.Array:
+    """Inference-mode generation (reference `sampler`, distriubted_model.py:131)."""
+    img, _ = generator_apply(params, state, z, cfg=cfg, train=False, labels=labels)
+    return img
+
+
+# ---------------------------------------------------------------------------
+# Discriminator
+# ---------------------------------------------------------------------------
+
+def discriminator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
+    """Returns (params, bn_state) for the discriminator.
+
+    Stage 0 has no BN, matching the reference (distriubted_model.py:118; its
+    `d_bn0` is created but never used — SURVEY.md §2.4 #7 — we don't create one).
+    """
+    k = cfg.num_up_layers
+    dtype = _dtype(cfg)
+    keys = jax.random.split(key, 2 * k + 2)
+
+    params: Pytree = {}
+    state: Pytree = {}
+    in_ch = cfg.c_dim + (cfg.num_classes if cfg.num_classes else 0)
+    for i in range(k):
+        out_ch = cfg.df_dim * (2 ** i)
+        params[f"conv{i}"] = conv2d_init(
+            keys[2 * i], in_ch, out_ch, kernel=cfg.kernel_size, dtype=dtype)
+        if i > 0:
+            bn_p, bn_s = batch_norm_init(keys[2 * i + 1], out_ch, dtype=dtype)
+            params[f"bn{i}"], state[f"bn{i}"] = bn_p, bn_s
+        in_ch = out_ch
+
+    flat = cfg.base_size * cfg.base_size * cfg.df_dim * (2 ** (k - 1))
+    params["head"] = linear_init(keys[-1], flat, 1, dtype=dtype)
+    return params, state
+
+
+def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
+                        cfg: ModelConfig, train: bool,
+                        labels: Optional[jax.Array] = None,
+                        axis_name: Optional[str] = None
+                        ) -> Tuple[jax.Array, jax.Array, Pytree]:
+    """image [B, S, S, c] -> (sigmoid(logit), logit [B, 1], new_bn_state)."""
+    k = cfg.num_up_layers
+    cdt = _cdtype(cfg)
+    new_state: Pytree = {}
+
+    h = image.astype(cdt)
+    if cfg.num_classes:
+        if labels is None:
+            raise ValueError("conditional discriminator requires labels")
+        onehot = jax.nn.one_hot(labels, cfg.num_classes, dtype=h.dtype)
+        maps = jnp.broadcast_to(onehot[:, None, None, :],
+                                h.shape[:3] + (cfg.num_classes,))
+        h = jnp.concatenate([h, maps], axis=-1)
+
+    for i in range(k):
+        h = conv2d_apply(params[f"conv{i}"], h, compute_dtype=cdt)
+        if i > 0:
+            h, new_state[f"bn{i}"] = batch_norm_apply(
+                params[f"bn{i}"], state[f"bn{i}"], h, train=train,
+                momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name)
+        h = lrelu(h, cfg.leak)
+
+    h = h.reshape(h.shape[0], -1)
+    logit = linear_apply(params["head"], h, compute_dtype=cdt)
+    logit = logit.astype(jnp.float32)
+    return jax.nn.sigmoid(logit), logit, new_state
+
+
+# ---------------------------------------------------------------------------
+# Whole-GAN convenience
+# ---------------------------------------------------------------------------
+
+def gan_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
+    """Initialize both networks.
+
+    Returns (params, state) with params = {"gen": ..., "disc": ...} — the
+    structural replacement for the reference's fragile substring split of one
+    flat variable list (`'d_' in name` / `'g_' in name`, image_train.py:107-108,
+    SURVEY.md §2.4 #6).
+    """
+    kg, kd = jax.random.split(key)
+    g_params, g_state = generator_init(kg, cfg)
+    d_params, d_state = discriminator_init(kd, cfg)
+    return ({"gen": g_params, "disc": d_params},
+            {"gen": g_state, "disc": d_state})

@@ -1,0 +1,16 @@
+"""Core ops: pure init/apply functions over pytrees, compiled by XLA onto the MXU.
+
+TPU-native re-expression of the reference's op layer
+(distriubted_model.py:156-213 linear/conv2d/deconv2d/lrelu and :15-52 batch_norm).
+"""
+
+from dcgan_tpu.ops.layers import (  # noqa: F401
+    conv2d_apply,
+    conv2d_init,
+    deconv2d_apply,
+    deconv2d_init,
+    linear_apply,
+    linear_init,
+    lrelu,
+)
+from dcgan_tpu.ops.norm import batch_norm_apply, batch_norm_init  # noqa: F401

@@ -1,0 +1,4 @@
+"""Training subsystem: losses, jitted train step, trainer loop, CLI."""
+
+from dcgan_tpu.train.losses import bce_gan_losses  # noqa: F401
+from dcgan_tpu.train.steps import TrainStepFns, init_train_state, make_train_step  # noqa: F401

@@ -1,0 +1,74 @@
+"""GAN losses.
+
+`bce_gan_losses` is the reference's loss trio (image_train.py:91-96):
+
+    d_loss_real = BCE(D_logits(real), 1)
+    d_loss_fake = BCE(D_logits(fake), 0)
+    g_loss      = BCE(D_logits(fake), 1)        # non-saturating generator loss
+    d_loss      = d_loss_real + d_loss_fake
+
+computed from logits (numerically stable log-sigmoid form — the reference relies
+on TF's `sigmoid_cross_entropy_with_logits` for the same reason).
+
+`wgan_gp` is the BASELINE.json WGAN-GP variant: Wasserstein critic losses plus a
+gradient penalty on interpolates, the grad-of-grad exercising `jax.grad` nesting
+(and, under a sharded mesh, differentiation through the GSPMD-inserted psum —
+SURVEY.md §7 hard part (c)).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sigmoid_bce(logits: jax.Array, target: float) -> jax.Array:
+    """Mean BCE-with-logits against a constant 0/1 target.
+
+    log(1+e^-|x|) form: stable for large |logits|.
+    """
+    neg_abs = -jnp.abs(logits)
+    loss = jnp.maximum(logits, 0.0) - logits * target + jnp.log1p(jnp.exp(neg_abs))
+    return jnp.mean(loss)
+
+
+def bce_gan_losses(real_logits: jax.Array, fake_logits: jax.Array
+                   ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Returns (d_loss, d_loss_real, d_loss_fake, g_loss)."""
+    d_loss_real = sigmoid_bce(real_logits, 1.0)
+    d_loss_fake = sigmoid_bce(fake_logits, 0.0)
+    g_loss = sigmoid_bce(fake_logits, 1.0)
+    return d_loss_real + d_loss_fake, d_loss_real, d_loss_fake, g_loss
+
+
+def wgan_losses(real_logits: jax.Array, fake_logits: jax.Array
+                ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Wasserstein critic/generator losses (no penalty term).
+
+    Returns (d_loss, d_loss_real, d_loss_fake, g_loss) with the same arity as
+    `bce_gan_losses` so the train step is loss-agnostic.
+    """
+    d_loss_real = -jnp.mean(real_logits)
+    d_loss_fake = jnp.mean(fake_logits)
+    g_loss = -jnp.mean(fake_logits)
+    return d_loss_real + d_loss_fake, d_loss_real, d_loss_fake, g_loss
+
+
+def gradient_penalty(critic_fn: Callable[[jax.Array], jax.Array],
+                     real: jax.Array, fake: jax.Array,
+                     key: jax.Array) -> jax.Array:
+    """WGAN-GP penalty E[(||∇_x D(x̂)|| - 1)^2] on x̂ = ε·real + (1-ε)·fake.
+
+    `critic_fn` maps a batch of images to per-example logits [B]. The inner
+    jax.grad here sits under the outer d-loss grad — double differentiation.
+    """
+    eps = jax.random.uniform(key, (real.shape[0],) + (1,) * (real.ndim - 1),
+                             dtype=real.dtype)
+    interp = eps * real + (1.0 - eps) * fake
+
+    grads = jax.grad(lambda x: jnp.sum(critic_fn(x)))(interp)
+    norms = jnp.sqrt(jnp.sum(jnp.square(grads.astype(jnp.float32)),
+                             axis=tuple(range(1, grads.ndim))) + 1e-12)
+    return jnp.mean(jnp.square(norms - 1.0))

@@ -1,0 +1,192 @@
+"""The GAN train step: two Adam optimizers, one compiled XLA program.
+
+Reference semantics being replaced (image_train.py:109-112, 147-194): two
+independent `AdamOptimizer(2e-4, β1=0.5).minimize` ops run in *one* sess.run on
+the same batch, with numpy-fed z and a device→host→device image round-trip per
+step (SURVEY.md §2.4 #2, #10). Here the whole step — z sampling, G forward,
+D forward ×2, both backward passes, both Adam applies, BN EMA updates — is one
+pure function built for `jax.jit(fn, donate_argnums=(0,))` (the trainer and
+`__graft_entry__` compile it exactly that way): zero host round-trips, and z is
+drawn on-device from a threaded PRNG key instead of `np.random.uniform` feeds
+(image_train.py:151-152).
+
+Two update modes (TrainConfig.update_mode):
+- "sequential" (default): D updates on the current G, then G updates against the
+  *updated* D — the canonical alternating GAN step the reference intended.
+- "fused": both gradients are taken at the same (pre-update) params and both
+  updates applied together — the reference's actual one-sess.run semantics,
+  kept behind a flag for strict-parity experiments.
+
+Under jit-with-sharding (parallel/), gradient all-reduce and synced-BN moments
+are inserted by GSPMD; for explicit-collective execution (shard_map) pass
+`axis_name` and grads/metrics are pmean'd by hand. Both replace the reference's
+per-worker async parameter-server pulls/pushes (image_train.py:55-67).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from dcgan_tpu.config import TrainConfig
+from dcgan_tpu.models.dcgan import (
+    discriminator_apply,
+    gan_init,
+    generator_apply,
+)
+from dcgan_tpu.train import losses as L
+
+Pytree = Any
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    """Adam(lr=2e-4, β1=0.5, β2=0.999, ε=1e-8) — the reference's optimizer
+    (image_train.py:109-112; β2/ε are TF AdamOptimizer defaults)."""
+    return optax.adam(cfg.learning_rate, b1=cfg.beta1, b2=0.999, eps=1e-8)
+
+
+def init_train_state(key, cfg: TrainConfig) -> Pytree:
+    """Build the full training state pytree.
+
+    The checkpointed logical set matches the reference's Saver contents
+    (SURVEY.md §5: G/D weights, BN β/γ + running stats, Adam moments, step).
+    """
+    params, bn = gan_init(key, cfg.model)
+    opt = make_optimizer(cfg)
+    return {
+        "params": params,
+        "bn": bn,
+        "opt": {
+            "gen": opt.init(params["gen"]),
+            "disc": opt.init(params["disc"]),
+        },
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainStepFns:
+    """Bundle of the compiled-surface functions for one TrainConfig."""
+    train_step: Callable  # (state, images, key[, labels]) -> (state, metrics)
+    sample: Callable      # (state, z[, labels]) -> images (EMA-stat BN)
+    init: Callable        # (key,) -> state
+
+
+def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None
+                    ) -> TrainStepFns:
+    mcfg = cfg.model
+    opt = make_optimizer(cfg)
+    wgan = cfg.loss == "wgan-gp"
+    gan_losses = L.wgan_losses if wgan else L.bce_gan_losses
+
+    def _pmean(x):
+        return lax.pmean(x, axis_name) if axis_name is not None else x
+
+    def d_loss_fn(d_params: Pytree, g_params: Pytree, bn: Pytree,
+                  images: jax.Array, z: jax.Array, gp_key,
+                  labels) -> Tuple[jax.Array, Tuple]:
+        fake, _ = generator_apply(g_params, bn["gen"], z, cfg=mcfg, train=True,
+                                  labels=labels, axis_name=axis_name)
+        # D sees real then fake, chaining BN state through both applications —
+        # the functional analogue of the reference's two discriminator() calls
+        # with reuse=True (image_train.py:82,85).
+        _, real_logits, d_bn1 = discriminator_apply(
+            d_params, bn["disc"], images, cfg=mcfg, train=True, labels=labels,
+            axis_name=axis_name)
+        _, fake_logits, d_bn2 = discriminator_apply(
+            d_params, d_bn1, fake, cfg=mcfg, train=True, labels=labels,
+            axis_name=axis_name)
+        d_loss, d_real, d_fake = gan_losses(real_logits, fake_logits)[:3]
+        gp = jnp.zeros((), jnp.float32)
+        if wgan:
+            def critic(x):
+                return discriminator_apply(
+                    d_params, bn["disc"], x, cfg=mcfg, train=True,
+                    labels=labels, axis_name=axis_name)[1][:, 0]
+            gp = L.gradient_penalty(critic, images.astype(jnp.float32),
+                                    fake.astype(jnp.float32), gp_key)
+            d_loss = d_loss + cfg.gp_weight * gp
+        return d_loss, (d_bn2, d_real, d_fake, gp)
+
+    def g_loss_fn(g_params: Pytree, d_params: Pytree, bn: Pytree,
+                  z: jax.Array, labels) -> Tuple[jax.Array, Tuple]:
+        fake, g_bn = generator_apply(g_params, bn["gen"], z, cfg=mcfg,
+                                     train=True, labels=labels,
+                                     axis_name=axis_name)
+        _, fake_logits, _ = discriminator_apply(
+            d_params, bn["disc"], fake, cfg=mcfg, train=True, labels=labels,
+            axis_name=axis_name)
+        if wgan:
+            g_loss = -jnp.mean(fake_logits)
+        else:  # non-saturating BCE generator loss (image_train.py:96)
+            g_loss = L.sigmoid_bce(fake_logits, 1.0)
+        return g_loss, (g_bn,)
+
+    def train_step(state: Pytree, images: jax.Array, key: jax.Array,
+                   labels: Optional[jax.Array] = None
+                   ) -> Tuple[Pytree, dict]:
+        z_key, gp_key = jax.random.split(key)
+        z = jax.random.uniform(
+            z_key, (images.shape[0], mcfg.z_dim),
+            minval=-1.0, maxval=1.0, dtype=jnp.float32)
+
+        params, bn = state["params"], state["bn"]
+
+        # --- D step ---------------------------------------------------------
+        (d_loss, (d_bn, d_real, d_fake, gp)), d_grads = jax.value_and_grad(
+            d_loss_fn, has_aux=True)(
+                params["disc"], params["gen"], bn, images, z, gp_key, labels)
+        d_grads = _pmean(d_grads)
+        d_updates, d_opt = opt.update(d_grads, state["opt"]["disc"],
+                                      params["disc"])
+        new_disc = optax.apply_updates(params["disc"], d_updates)
+
+        if cfg.update_mode == "sequential":
+            g_target_disc = new_disc
+            g_bn_in = {"gen": bn["gen"], "disc": d_bn}
+        else:  # "fused": reference parity — G grads at pre-update D params
+            g_target_disc = params["disc"]
+            g_bn_in = bn
+
+        # --- G step ---------------------------------------------------------
+        (g_loss, (g_bn,)), g_grads = jax.value_and_grad(
+            g_loss_fn, has_aux=True)(
+                params["gen"], g_target_disc, g_bn_in, z, labels)
+        g_grads = _pmean(g_grads)
+        g_updates, g_opt = opt.update(g_grads, state["opt"]["gen"],
+                                      params["gen"])
+        new_gen = optax.apply_updates(params["gen"], g_updates)
+
+        new_state = {
+            "params": {"gen": new_gen, "disc": new_disc},
+            "bn": {"gen": g_bn, "disc": d_bn},
+            "opt": {"gen": g_opt, "disc": d_opt},
+            # Unlike the reference's global_step (G-updates only, SURVEY.md
+            # §2.4 #3), this counts full D+G steps.
+            "step": state["step"] + 1,
+        }
+        metrics = {
+            "d_loss": _pmean(d_loss),
+            "d_loss_real": _pmean(d_real),
+            "d_loss_fake": _pmean(d_fake),
+            "g_loss": _pmean(g_loss),
+        }
+        if wgan:
+            metrics["gp"] = _pmean(gp)
+        return new_state, metrics
+
+    def sample(state: Pytree, z: jax.Array,
+               labels: Optional[jax.Array] = None) -> jax.Array:
+        img, _ = generator_apply(state["params"]["gen"], state["bn"]["gen"], z,
+                                 cfg=mcfg, train=False, labels=labels)
+        return img
+
+    def init(key):
+        return init_train_state(key, cfg)
+
+    return TrainStepFns(train_step=train_step, sample=sample, init=init)

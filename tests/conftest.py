@@ -1,0 +1,22 @@
+"""Test env: 8 virtual CPU devices — the JAX-native "fake cluster" (SURVEY.md §4).
+
+Must run before the first `import jax` anywhere in the test process.
+"""
+
+import os
+
+# Force CPU: the ambient environment may pin JAX_PLATFORMS to a real TPU
+# backend; tests must run on the virtual 8-device CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The ambient TPU plugin may have force-selected its own platform via
+# jax.config.update("jax_platforms", ...) at interpreter startup, which beats
+# the env var — override it back so tests never dial the real chip.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)

@@ -1,0 +1,112 @@
+"""Model tests: G/D/sampler shapes, conditioning, 128x128 config, EMA-sampler
+semantics (reference parity: distriubted_model.py:83-153)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcgan_tpu.config import ModelConfig
+from dcgan_tpu.models import (
+    discriminator_apply,
+    discriminator_init,
+    gan_init,
+    generator_apply,
+    generator_init,
+    sampler_apply,
+)
+
+CFG = ModelConfig(compute_dtype="float32")  # f32 on CPU for numerics
+
+
+def test_generator_output_shape_and_range():
+    p, s = generator_init(jax.random.key(0), CFG)
+    z = jax.random.uniform(jax.random.key(1), (8, 100), minval=-1, maxval=1)
+    img, s1 = generator_apply(p, s, z, cfg=CFG, train=True)
+    assert img.shape == (8, 64, 64, 3)
+    assert float(jnp.max(img)) <= 1.0 and float(jnp.min(img)) >= -1.0
+    # BN state updated for bn0..bn3 (4 up layers -> 3 inner BNs + bn0)
+    assert set(s1.keys()) == {"bn0", "bn1", "bn2", "bn3"}
+
+
+def test_generator_batch_size_not_hardcoded():
+    """The reference hard-codes batch 64 into every deconv output_shape
+    (distriubted_model.py:93-109); ours must follow the input batch."""
+    p, s = generator_init(jax.random.key(0), CFG)
+    for b in (1, 3, 16):
+        z = jnp.zeros((b, 100))
+        img, _ = generator_apply(p, s, z, cfg=CFG, train=True)
+        assert img.shape == (b, 64, 64, 3)
+
+
+def test_discriminator_shapes():
+    p, s = discriminator_init(jax.random.key(0), CFG)
+    x = jax.random.normal(jax.random.key(1), (8, 64, 64, 3))
+    prob, logit, s1 = discriminator_apply(p, s, x, cfg=CFG, train=True)
+    assert prob.shape == (8, 1) and logit.shape == (8, 1)
+    np.testing.assert_allclose(np.asarray(prob),
+                               np.asarray(jax.nn.sigmoid(logit)), rtol=1e-6)
+    # stage 0 has no BN (reference: d_bn0 unused, SURVEY.md §2.4 #7)
+    assert set(s1.keys()) == {"bn1", "bn2", "bn3"}
+    assert "bn0" not in p
+
+
+def test_sampler_uses_running_stats():
+    """sampler == generator with train=False reading the EMA stats captured by
+    train-mode calls — the reference's implicit coupling
+    (distriubted_model.py:42,47) made explicit."""
+    p, s = generator_init(jax.random.key(0), CFG)
+    z = jax.random.uniform(jax.random.key(1), (4, 100), minval=-1, maxval=1)
+    # advance the EMA with a few train steps
+    for i in range(3):
+        _, s = generator_apply(p, s, z + 0.1 * i, cfg=CFG, train=True)
+    out1 = sampler_apply(p, s, z, cfg=CFG)
+    out2, s_after = generator_apply(p, s, z, cfg=CFG, train=False)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(out2))
+    # eval never mutates the running stats
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        s_after, s)
+    # and train-mode output differs (batch stats vs EMA stats)
+    out_train, _ = generator_apply(p, s, z, cfg=CFG, train=True)
+    assert float(jnp.max(jnp.abs(out_train - out1))) > 1e-4
+
+
+def test_128x128_config():
+    cfg = ModelConfig(output_size=128, compute_dtype="float32")
+    assert cfg.num_up_layers == 5
+    p, s = generator_init(jax.random.key(0), cfg)
+    # top projection goes to gf*16 channels for the 5-stage stack
+    assert p["proj"]["w"].shape == (100, 16 * 64 * 4 * 4)
+    img, _ = generator_apply(p, s, jnp.zeros((2, 100)), cfg=cfg, train=True)
+    assert img.shape == (2, 128, 128, 3)
+    dp, ds = discriminator_init(jax.random.key(1), cfg)
+    _, logit, _ = discriminator_apply(dp, ds, img, cfg=cfg, train=True)
+    assert logit.shape == (2, 1)
+
+
+def test_conditional_dcgan():
+    """CIFAR-10-style class conditioning (BASELINE.json config #4; activates the
+    reference's dead `y` arg, distriubted_model.py:83)."""
+    cfg = ModelConfig(output_size=32, base_size=4, num_classes=10,
+                      compute_dtype="float32")
+    p, s = gan_init(jax.random.key(0), cfg)
+    z = jnp.zeros((4, 100))
+    y = jnp.array([0, 3, 7, 9])
+    img, _ = generator_apply(p["gen"], s["gen"], z, cfg=cfg, train=True, labels=y)
+    assert img.shape == (4, 32, 32, 3)
+    _, logit, _ = discriminator_apply(p["disc"], s["disc"], img, cfg=cfg,
+                                      train=True, labels=y)
+    assert logit.shape == (4, 1)
+    # different labels must produce different images for the same z
+    img2, _ = generator_apply(p["gen"], s["gen"], z, cfg=cfg, train=True,
+                              labels=jnp.array([1, 4, 8, 2]))
+    assert float(jnp.max(jnp.abs(img - img2))) > 1e-4
+    with pytest.raises(ValueError):
+        generator_apply(p["gen"], s["gen"], z, cfg=cfg, train=True)
+
+
+def test_gan_init_partitions_params():
+    p, s = gan_init(jax.random.key(0), CFG)
+    assert set(p.keys()) == {"gen", "disc"}
+    assert set(s.keys()) == {"gen", "disc"}

@@ -1,0 +1,121 @@
+"""Unit tests for the op layer: shapes, init distributions, BN EMA semantics
+(the reference had no tests at all — SURVEY.md §4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcgan_tpu.ops import (
+    batch_norm_apply,
+    batch_norm_init,
+    conv2d_apply,
+    conv2d_init,
+    deconv2d_apply,
+    deconv2d_init,
+    linear_apply,
+    linear_init,
+    lrelu,
+)
+
+
+def test_linear_shapes_and_init():
+    p = linear_init(jax.random.key(0), 100, 8192)
+    assert p["w"].shape == (100, 8192)
+    assert p["b"].shape == (8192,)
+    # W ~ N(0, 0.02) (reference init, distriubted_model.py:165-166)
+    assert abs(float(jnp.std(p["w"])) - 0.02) < 0.002
+    assert float(jnp.max(jnp.abs(p["b"]))) == 0.0
+    y = linear_apply(p, jnp.ones((4, 100)))
+    assert y.shape == (4, 8192)
+
+
+def test_conv2d_downsamples_by_stride():
+    p = conv2d_init(jax.random.key(1), 3, 64)
+    assert p["w"].shape == (5, 5, 3, 64)
+    # truncated normal: no sample beyond 2 sigma
+    assert float(jnp.max(jnp.abs(p["w"]))) <= 2 * 0.02 + 1e-6
+    x = jnp.ones((2, 64, 64, 3))
+    y = conv2d_apply(p, x)
+    assert y.shape == (2, 32, 32, 64)
+
+
+def test_deconv2d_upsamples_by_stride():
+    p = deconv2d_init(jax.random.key(2), 512, 256)
+    x = jnp.ones((2, 4, 4, 512))
+    y = deconv2d_apply(p, x)
+    assert y.shape == (2, 8, 8, 256)
+
+
+def test_conv_deconv_bf16_compute_keeps_shapes():
+    p = conv2d_init(jax.random.key(3), 3, 8)
+    y = conv2d_apply(p, jnp.ones((1, 16, 16, 3)), compute_dtype=jnp.bfloat16)
+    assert y.dtype == jnp.bfloat16 and y.shape == (1, 8, 8, 8)
+
+
+def test_lrelu():
+    x = jnp.array([-1.0, 0.0, 2.0])
+    np.testing.assert_allclose(lrelu(x), [-0.2, 0.0, 2.0], rtol=1e-6)
+
+
+class TestBatchNorm:
+    def test_train_normalizes_batch(self):
+        p, s = batch_norm_init(jax.random.key(0), 8)
+        x = 5.0 + 3.0 * jax.random.normal(jax.random.key(1), (32, 4, 4, 8))
+        y, _ = batch_norm_apply(p, s, x, train=True)
+        # output moments ~ (0,1) modulated by scale/bias (scale ~ N(1,0.02))
+        m = jnp.mean(y, axis=(0, 1, 2))
+        v = jnp.var(y, axis=(0, 1, 2))
+        np.testing.assert_allclose(np.asarray(m), np.asarray(p["bias"]),
+                                   atol=1e-3)
+        np.testing.assert_allclose(np.asarray(v),
+                                   np.asarray(p["scale"]) ** 2, rtol=0.05)
+
+    def test_ema_update_rule(self):
+        """EMA: new = 0.9*old + 0.1*batch (momentum 0.9, the reference's
+        ExponentialMovingAverage decay, distriubted_model.py:23)."""
+        p, s = batch_norm_init(jax.random.key(0), 4)
+        x = 2.0 + jax.random.normal(jax.random.key(1), (64, 8, 8, 4))
+        _, s1 = batch_norm_apply(p, s, x, train=True, momentum=0.9)
+        batch_mean = jnp.mean(x, axis=(0, 1, 2))
+        expect = 0.9 * s["mean"] + 0.1 * batch_mean
+        np.testing.assert_allclose(np.asarray(s1["mean"]), np.asarray(expect),
+                                   rtol=1e-5)
+
+    def test_eval_uses_running_stats(self):
+        p, s = batch_norm_init(jax.random.key(0), 4)
+        s = {"mean": jnp.full((4,), 2.0), "var": jnp.full((4,), 4.0)}
+        x = jnp.full((2, 3, 3, 4), 2.0)
+        y, s_out = batch_norm_apply(p, s, x, train=False)
+        # (2-2)/2 * scale + bias = bias
+        np.testing.assert_allclose(
+            np.asarray(y[0, 0, 0]), np.asarray(p["bias"]), atol=1e-5)
+        assert s_out is s  # eval must not mutate state
+
+    def test_2d_input(self):
+        """The reference special-cases 2-D inputs (moments over [0,1],
+        distriubted_model.py:38-39); here 'all but channel' covers it."""
+        p, s = batch_norm_init(jax.random.key(0), 16)
+        x = jax.random.normal(jax.random.key(1), (64, 16))
+        y, _ = batch_norm_apply(p, s, x, train=True)
+        assert y.shape == (64, 16)
+        np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=0)),
+                                   np.asarray(p["bias"]), atol=1e-3)
+
+    def test_synced_moments_pmean(self):
+        """Cross-replica BN: pmean'd moments under pmap equal global moments."""
+        n = jax.local_device_count()
+        p, s = batch_norm_init(jax.random.key(0), 4)
+        x = jax.random.normal(jax.random.key(1), (n, 8, 2, 2, 4)) * 3.0 + 1.0
+
+        def f(xs):
+            y, s1 = batch_norm_apply(p, s, xs, train=True, axis_name="d")
+            return y, s1
+
+        _, s_sync = jax.pmap(f, axis_name="d")(x)
+        global_mean = jnp.mean(x.reshape(-1, 4)[:, :], axis=0)
+        expect = 0.9 * s["mean"] + 0.1 * global_mean
+        # every replica must hold identical, globally-synced stats
+        for i in range(n):
+            np.testing.assert_allclose(np.asarray(s_sync["mean"][i]),
+                                       np.asarray(expect), rtol=1e-4)

@@ -1,0 +1,169 @@
+"""Training-layer tests: loss values, update modes, BN-state plumbing, WGAN-GP,
+overfit smoke, determinism (SURVEY.md §4 test plan)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcgan_tpu.config import ModelConfig, TrainConfig
+from dcgan_tpu.train import make_train_step
+from dcgan_tpu.train.losses import (
+    bce_gan_losses,
+    gradient_penalty,
+    sigmoid_bce,
+    wgan_losses,
+)
+
+TINY = ModelConfig(output_size=16, gf_dim=8, df_dim=8, base_size=4,
+                   compute_dtype="float32")
+
+
+def tiny_cfg(**kw):
+    return TrainConfig(model=TINY, batch_size=8, **kw)
+
+
+def real_batch(n=8, size=16):
+    rng = np.random.default_rng(0)
+    return jnp.asarray(
+        np.tanh(rng.normal(size=(n, size, size, 3))).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+class TestLosses:
+    def test_sigmoid_bce_golden(self):
+        """Golden values: BCE(0, t) = log 2 for either target."""
+        z = jnp.zeros((4,))
+        np.testing.assert_allclose(float(sigmoid_bce(z, 1.0)), np.log(2),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(sigmoid_bce(z, 0.0)), np.log(2),
+                                   rtol=1e-6)
+        # large logits are numerically stable, not inf/nan
+        big = jnp.array([1e4, -1e4])
+        assert np.isfinite(float(sigmoid_bce(big, 1.0)))
+        np.testing.assert_allclose(float(sigmoid_bce(jnp.array([1e4]), 1.0)),
+                                   0.0, atol=1e-6)
+
+    def test_bce_gan_losses_trio(self):
+        """The reference's loss trio (image_train.py:91-96): d = real + fake."""
+        r = jnp.array([2.0, -1.0])
+        f = jnp.array([0.5, 0.0])
+        d, dr, df, g = bce_gan_losses(r, f)
+        np.testing.assert_allclose(float(d), float(dr) + float(df), rtol=1e-6)
+        np.testing.assert_allclose(float(dr), float(sigmoid_bce(r, 1.0)))
+        np.testing.assert_allclose(float(df), float(sigmoid_bce(f, 0.0)))
+        np.testing.assert_allclose(float(g), float(sigmoid_bce(f, 1.0)))
+
+    def test_wgan_losses(self):
+        r = jnp.array([3.0, 1.0])
+        f = jnp.array([0.5, 1.5])
+        d, dr, df, g = wgan_losses(r, f)
+        np.testing.assert_allclose(float(d), -2.0 + 1.0, rtol=1e-6)
+        np.testing.assert_allclose(float(g), -1.0, rtol=1e-6)
+
+    def test_gradient_penalty_golden(self):
+        """For D(x) = a.x, grad norm is ||a|| everywhere: gp = (||a||-1)^2."""
+        a = jnp.array([3.0, 4.0])  # ||a|| = 5
+        critic = lambda x: x @ a
+        real = jnp.ones((16, 2))
+        fake = -jnp.ones((16, 2))
+        gp = gradient_penalty(critic, real, fake, jax.random.key(0))
+        np.testing.assert_allclose(float(gp), 16.0, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# train step
+# ---------------------------------------------------------------------------
+
+class TestTrainStep:
+    def test_step_updates_everything(self):
+        fns = make_train_step(tiny_cfg())
+        s0 = fns.init(jax.random.key(0))
+        s1, m = jax.jit(fns.train_step)(s0, real_batch(), jax.random.key(1))
+        assert int(s1["step"]) == 1
+        for net in ("gen", "disc"):
+            # params moved
+            diff = jax.tree_util.tree_map(
+                lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                s0["params"][net], s1["params"][net])
+            assert max(jax.tree_util.tree_leaves(diff)) > 0
+        # BN running stats moved for both nets
+        for net in ("gen", "disc"):
+            diff = jax.tree_util.tree_map(
+                lambda a, b: float(jnp.max(jnp.abs(a - b))),
+                s0["bn"][net], s1["bn"][net])
+            assert max(jax.tree_util.tree_leaves(diff)) > 0
+        for k in ("d_loss", "d_loss_real", "d_loss_fake", "g_loss"):
+            assert np.isfinite(float(m[k])), k
+
+    def test_sequential_vs_fused_differ(self):
+        """Sequential G-step sees the updated D; fused (reference parity,
+        SURVEY.md §2.4 #2) sees the pre-update D — gen updates must differ."""
+        xs, key = real_batch(), jax.random.key(1)
+        outs = {}
+        for mode in ("sequential", "fused"):
+            fns = make_train_step(tiny_cfg(update_mode=mode))
+            s = fns.init(jax.random.key(0))
+            s1, _ = jax.jit(fns.train_step)(s, xs, key)
+            outs[mode] = s1
+        d_gen = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))),
+            outs["sequential"]["params"]["gen"], outs["fused"]["params"]["gen"])
+        assert max(jax.tree_util.tree_leaves(d_gen)) > 0
+        # D step itself is identical in both modes
+        d_disc = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))),
+            outs["sequential"]["params"]["disc"], outs["fused"]["params"]["disc"])
+        assert max(jax.tree_util.tree_leaves(d_disc)) == 0
+
+    def test_wgan_gp_step(self):
+        fns = make_train_step(tiny_cfg(loss="wgan-gp"))
+        s = fns.init(jax.random.key(0))
+        s, m = jax.jit(fns.train_step)(s, real_batch(), jax.random.key(1))
+        assert "gp" in m and np.isfinite(float(m["gp"]))
+        assert np.isfinite(float(m["d_loss"]))
+
+    def test_determinism(self):
+        """Fixed PRNG key -> bitwise-identical step on CPU (SURVEY.md §4)."""
+        fns = make_train_step(tiny_cfg())
+        step = jax.jit(fns.train_step)
+        xs, key = real_batch(), jax.random.key(7)
+        s_a, m_a = step(fns.init(jax.random.key(0)), xs, key)
+        s_b, m_b = step(fns.init(jax.random.key(0)), xs, key)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), s_a["params"], s_b["params"])
+        assert float(m_a["d_loss"]) == float(m_b["d_loss"])
+
+    def test_overfit_smoke(self):
+        """1-batch overfit: D separates real from fake within 40 steps
+        (d_loss well below its log(4)≈1.386 untrained value) and G's loss
+        responds — the end-to-end trajectory check from SURVEY.md §4."""
+        fns = make_train_step(tiny_cfg())
+        step = jax.jit(fns.train_step, donate_argnums=(0,))
+        s = fns.init(jax.random.key(0))
+        xs = real_batch()
+        base = jax.random.key(1)
+        first = last = None
+        for i in range(40):
+            s, m = step(s, xs, jax.random.fold_in(base, i))
+            if first is None:
+                first = {k: float(v) for k, v in m.items()}
+            last = {k: float(v) for k, v in m.items()}
+        assert last["d_loss"] < first["d_loss"]
+        assert last["d_loss"] < 1.0
+        assert np.isfinite(last["g_loss"])
+
+    def test_conditional_step(self):
+        cfg = TrainConfig(
+            model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                              num_classes=4, compute_dtype="float32"),
+            batch_size=8)
+        fns = make_train_step(cfg)
+        s = fns.init(jax.random.key(0))
+        y = jnp.arange(8) % 4
+        s, m = jax.jit(fns.train_step)(s, real_batch(), jax.random.key(1), y)
+        assert np.isfinite(float(m["d_loss"]))
