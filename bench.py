@@ -1,0 +1,88 @@
+"""Benchmark: DCGAN-64 training throughput (images/sec/chip).
+
+Flagship config = the reference's headline workload: DCGAN 64x64, batch 64,
+z=100, Adam(2e-4, 0.5) — its hot loop ran two host<->device round-trips, a
+numpy-fed z, and a gRPC weight sync per step (image_train.py:147-194,
+SURVEY.md §3.1). Here the whole D+G step is one compiled XLA program with
+donated state and on-device PRNG, so steady-state throughput is pure device
+time.
+
+Baseline: the reference publishes no numbers (BASELINE.md). The driver-defined
+north star is >=4x a single-V100 TF DCGAN-64 baseline; public single-V100
+TF DCGAN-64 trainers at batch 64 sustain roughly 2000 images/sec, which we
+adopt (documented assumption) as baseline=2000 for vs_baseline.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+V100_TF_BASELINE_IMG_PER_SEC = 2000.0
+
+# The reference's headline workload knobs (image_train.py:42-48).
+# BENCH_* env overrides exist for local smoke runs (e.g. BENCH_PLATFORM=cpu
+# BENCH_BATCH=8 BENCH_STEPS=3); the driver's TPU run uses the defaults.
+BATCH = int(os.environ.get("BENCH_BATCH", 64))
+STEPS_MEASURE = int(os.environ.get("BENCH_STEPS", 30))
+STEPS_WARMUP = 3
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        # The ambient TPU plugin force-selects its platform via jax.config at
+        # interpreter startup; honor an explicit override for CPU smoke runs.
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    import jax.numpy as jnp
+
+    from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+    from dcgan_tpu.parallel import make_mesh, make_parallel_train
+
+    n_chips = len(jax.devices())
+    cfg = TrainConfig(
+        model=ModelConfig(),       # 64x64, gf=df=64, bf16 compute
+        batch_size=BATCH * n_chips,
+        mesh=MeshConfig())
+    mesh = make_mesh(cfg.mesh)
+    pt = make_parallel_train(cfg, mesh)
+
+    state = pt.init(jax.random.key(0))
+    images = jnp.asarray(np.random.default_rng(0).uniform(
+        -1, 1, size=(cfg.batch_size, 64, 64, 3)).astype(np.float32))
+    base = jax.random.key(1)
+
+    for i in range(STEPS_WARMUP):
+        state, metrics = pt.step(state, images, jax.random.fold_in(base, i))
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for i in range(STEPS_MEASURE):
+        state, metrics = pt.step(state, images,
+                                 jax.random.fold_in(base, STEPS_WARMUP + i))
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = cfg.batch_size * STEPS_MEASURE / dt
+    img_per_sec_chip = img_per_sec / n_chips
+    print(json.dumps({
+        "metric": f"DCGAN-64 train throughput (batch {BATCH}/chip, bf16)",
+        "value": round(img_per_sec_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec_chip / V100_TF_BASELINE_IMG_PER_SEC, 3),
+    }))
+    # context to stderr so the stdout contract stays one JSON line
+    print(f"chips={n_chips} global_batch={cfg.batch_size} "
+          f"steps={STEPS_MEASURE} wall={dt:.2f}s "
+          f"d_loss={float(metrics['d_loss']):.3f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

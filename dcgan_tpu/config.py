@@ -2,7 +2,8 @@
 (`image_train.py:10-38` tf.app.flags) re-expressed as typed, validated dataclasses.
 
 Unlike the reference, model hyperparameters here are *wired*: changing
-`ModelConfig.batch_size`/`output_size`/`c_dim` actually changes the model (the
+`ModelConfig.output_size`/`c_dim` or `TrainConfig.batch_size` actually changes
+the built model/step (the
 reference's flags of the same names were disconnected from the module constants
 actually used — SURVEY.md §2.4 #8, distriubted_model.py:7-12 vs image_train.py:15-18).
 """
@@ -67,7 +68,9 @@ class MeshConfig:
     model: int = 1                 # tensor-parallel axis size (latent; 1 = off)
 
     def axis_sizes(self, n_devices: int) -> Tuple[int, int]:
-        model = max(1, self.model)
+        if self.model < 1:
+            raise ValueError(f"model axis must be >= 1, got {self.model}")
+        model = self.model
         if self.data > 0:
             data = self.data
         else:

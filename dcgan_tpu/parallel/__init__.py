@@ -1,0 +1,25 @@
+"""Parallelism: device mesh, sharding rules, sharded train step, multi-host init.
+
+This package replaces the reference's entire cluster runtime — ClusterSpec /
+tf.train.Server / ps-role / replica_device_setter / Supervisor session fabric
+(image_train.py:52-67,122-141) and the `/job:ps/task:0` variable pinning
+(distriubted_model.py:66-72). There is no parameter-server process: parameters
+are replicated (or tensor-sharded) across the mesh per explicit sharding rules,
+the batch is sharded over the "data" axis, and GSPMD inserts psum/all-gather
+collectives over ICI where the reference did per-worker gRPC weight pulls and
+Hogwild update pushes.
+"""
+
+from dcgan_tpu.parallel.mesh import make_mesh  # noqa: F401
+from dcgan_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    replicated,
+    state_shardings,
+)
+from dcgan_tpu.parallel.api import ParallelTrain, make_parallel_train  # noqa: F401
+from dcgan_tpu.parallel.distributed import (  # noqa: F401
+    initialize_multihost,
+    is_chief,
+    process_count,
+    process_index,
+)

@@ -1,0 +1,49 @@
+"""Multi-host bring-up over DCN.
+
+Replaces the reference's process topology — explicit ps_hosts/worker_hosts
+flags, per-process tf.train.Server, ps processes blocking in server.join()
+(image_train.py:27-38,52-63) — with JAX's coordinator-based runtime: every
+process is a worker, `jax.distributed.initialize` forms the job over DCN, and
+XLA sees one global device set. "Chief" (the reference's task_index==0
+Supervisor role, image_train.py:123-129) becomes process_index()==0, which the
+trainer uses to gate checkpointing, metrics, and sample grids.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Form the multi-host job. No-ops on single-process runs.
+
+    Args may come from the environment (JAX_COORDINATOR_ADDRESS etc.) the way
+    the reference read ps_hosts/worker_hosts/task_index flags.
+    """
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None and num_processes is None:
+        return  # single-process
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_chief() -> bool:
+    """The observability/checkpoint owner (reference: is_chief = task_index==0,
+    image_train.py:124)."""
+    return jax.process_index() == 0

@@ -1,0 +1,36 @@
+"""Device-mesh construction.
+
+The mesh is the topology abstraction that replaces the reference's
+ClusterSpec({"ps": ..., "worker": ...}) (image_train.py:52-55). Axes:
+
+- "data"  — batch sharding; gradient all-reduce rides ICI across it.
+- "model" — tensor-parallel axis for the widest weights (latent for DCGAN
+  parity — the reference has no TP — but wired end-to-end so larger models
+  shard without redesign; SURVEY.md §2.5).
+
+Axis order puts "model" innermost so model-parallel collectives map onto the
+fastest ICI links under the default device order.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from dcgan_tpu.config import MeshConfig
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a (data, model) Mesh over `devices` (default: all devices)."""
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    data, model = cfg.axis_sizes(len(devices))
+    arr = np.asarray(devices).reshape(data, model)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS))

@@ -1,0 +1,73 @@
+"""Sharding rules: pytree path -> PartitionSpec.
+
+The reference's placement policy was one line — every variable pinned to
+`/job:ps/task:0` (distriubted_model.py:70) — plus replica_device_setter for
+driver-created variables (image_train.py:65-67). Here placement is explicit
+per-leaf:
+
+- batch-dim tensors shard over "data";
+- the widest weights shard over "model" (tensor parallelism): the generator
+  projection [z, top_ch*S*S] and discriminator head [flat, 1] on their large
+  axis, conv/deconv kernels [h,w,i,o] on output channels;
+- everything small (biases, BN scale/bias/stats, Adam scalars, step) is
+  replicated.
+
+With MeshConfig(model=1) the model axis has size 1 and every rule degrades to
+pure data parallelism — params replicated, grads psum'd — the reference's
+capability re-expressed synchronously.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dcgan_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+Pytree = Any
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
+    """Shard dim 0 (batch) over "data"; e.g. images [B,H,W,C], labels [B]."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
+
+
+def _spec_for_leaf(path, leaf, model_size: int) -> P:
+    names = [p.key for p in path if hasattr(p, "key")]
+    shape = getattr(leaf, "shape", ())
+    if not names or len(shape) == 0:
+        return P()
+
+    def ok(dim):  # a dim only shards if the model axis divides it
+        return shape[dim] % model_size == 0
+
+    is_weight = names[-1] == "w"
+    if is_weight and len(shape) == 4 and ok(3):
+        # conv/deconv kernel [h, w, in, out] -> shard output channels
+        # (the c_dim-output deconv stays replicated: 3 % model_size != 0)
+        return P(None, None, None, MODEL_AXIS)
+    if is_weight and len(shape) == 2:
+        if "proj" in names and ok(1):   # generator projection: huge output dim
+            return P(None, MODEL_AXIS)
+        if "head" in names and ok(0):   # discriminator head: huge input dim
+            return P(MODEL_AXIS, None)
+    return P()
+
+
+def state_shardings(state_shapes: Pytree, mesh: Mesh) -> Pytree:
+    """Map a ShapeDtypeStruct tree (from jax.eval_shape on init) to a matching
+    tree of NamedShardings. Works for the whole train state: params and Adam
+    moments (mu/nu mirror the param tree, so the same path rules hit them) get
+    TP rules; BN state and counters come out replicated.
+    """
+    model_size = mesh.shape[MODEL_AXIS]
+
+    def to_sharding(path, leaf):
+        return NamedSharding(mesh, _spec_for_leaf(path, leaf, model_size))
+    return jax.tree_util.tree_map_with_path(to_sharding, state_shapes)

@@ -38,6 +38,7 @@ from dcgan_tpu.models.dcgan import (
     discriminator_apply,
     gan_init,
     generator_apply,
+    sampler_apply,
 )
 from dcgan_tpu.train import losses as L
 
@@ -104,9 +105,13 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None
         d_loss, d_real, d_fake = gan_losses(real_logits, fake_logits)[:3]
         gp = jnp.zeros((), jnp.float32)
         if wgan:
+            # Penalty critic runs with train=False (running BN stats):
+            # batch-stat BN couples D(x_i) to every x_j in the batch, which
+            # would contaminate the per-example ||grad_x D(x̂)|| the
+            # 1-Lipschitz constraint is defined on.
             def critic(x):
                 return discriminator_apply(
-                    d_params, bn["disc"], x, cfg=mcfg, train=True,
+                    d_params, bn["disc"], x, cfg=mcfg, train=False,
                     labels=labels, axis_name=axis_name)[1][:, 0]
             gp = L.gradient_penalty(critic, images.astype(jnp.float32),
                                     fake.astype(jnp.float32), gp_key)
@@ -182,9 +187,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None
 
     def sample(state: Pytree, z: jax.Array,
                labels: Optional[jax.Array] = None) -> jax.Array:
-        img, _ = generator_apply(state["params"]["gen"], state["bn"]["gen"], z,
-                                 cfg=mcfg, train=False, labels=labels)
-        return img
+        return sampler_apply(state["params"]["gen"], state["bn"]["gen"], z,
+                             cfg=mcfg, labels=labels)
 
     def init(key):
         return init_train_state(key, cfg)

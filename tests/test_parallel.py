@@ -1,0 +1,135 @@
+"""Multi-device tests on the 8-virtual-CPU mesh: DP equivalence, TP sharding,
+synced BN across shards (SURVEY.md §4, §7 phase 4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from dcgan_tpu.parallel import (
+    batch_sharding,
+    make_mesh,
+    make_parallel_train,
+    state_shardings,
+)
+from dcgan_tpu.train import make_train_step
+
+TINY = ModelConfig(output_size=16, gf_dim=8, df_dim=8, compute_dtype="float32")
+
+
+def real_batch(n=16, size=16):
+    rng = np.random.default_rng(0)
+    return jnp.asarray(
+        np.tanh(rng.normal(size=(n, size, size, 3))).astype(np.float32))
+
+
+def max_abs_diff(a, b):
+    d = jax.tree_util.tree_map(lambda x, y: float(jnp.max(jnp.abs(x - y))), a, b)
+    return max(jax.tree_util.tree_leaves(d))
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh(MeshConfig())
+    assert mesh.devices.size == 8 and mesh.axis_names == ("data", "model")
+    mesh2 = make_mesh(MeshConfig(model=2))
+    assert mesh2.shape["data"] == 4 and mesh2.shape["model"] == 2
+
+
+def test_sharding_rules():
+    cfg = TrainConfig(model=TINY, batch_size=16, mesh=MeshConfig(model=2))
+    mesh = make_mesh(cfg.mesh)
+    fns = make_train_step(cfg)
+    shapes = jax.eval_shape(fns.init, jax.random.key(0))
+    sh = state_shardings(shapes, mesh)
+    # conv kernels shard out-channels on "model"
+    assert sh["params"]["disc"]["conv0"]["w"].spec == P(None, None, None, "model")
+    # generator projection shards its wide output dim
+    assert sh["params"]["gen"]["proj"]["w"].spec == P(None, "model")
+    # head shards its wide input dim
+    assert sh["params"]["disc"]["head"]["w"].spec == P("model", None)
+    # BN params/stats and biases replicated
+    assert sh["params"]["gen"]["bn0"]["scale"].spec == P()
+    assert sh["bn"]["disc"]["bn1"]["mean"].spec == P()
+    # Adam moments mirror the param rules (mu lives under the same leaf paths)
+    opt_leaves = jax.tree_util.tree_leaves_with_path(sh["opt"]["gen"])
+    conv_mu = [s for path, s in opt_leaves
+               if any(getattr(p, "key", None) == "deconv1" for p in path)
+               and any(getattr(p, "key", None) == "w" for p in path)]
+    assert conv_mu and all(s.spec == P(None, None, None, "model")
+                           for s in conv_mu)
+
+
+@pytest.mark.parametrize("mesh_cfg", [MeshConfig(), MeshConfig(model=2)],
+                         ids=["dp8", "dp4xtp2"])
+def test_sharded_step_matches_single_device(mesh_cfg):
+    """The sharded SPMD step must be numerically equivalent to the unsharded
+    step — data parallelism here is synchronous (one global batch, global BN
+    moments, all-reduced grads), NOT the reference's async Hogwild
+    (SURVEY.md §2.5)."""
+    cfg = TrainConfig(model=TINY, batch_size=16, mesh=mesh_cfg)
+    xs, key = real_batch(), jax.random.key(3)
+
+    fns = make_train_step(cfg)
+    s_ref, m_ref = jax.jit(fns.train_step)(fns.init(jax.random.key(0)), xs, key)
+
+    pt = make_parallel_train(cfg)
+    s_par = pt.init(jax.random.key(0))
+    s_par, m_par = pt.step(s_par, xs, key)
+
+    # Losses agree tightly; params loosely — Adam's first step is
+    # ~±lr·sign(grad), so f32 reduction-order noise between partitionings can
+    # flip near-zero gradient signs, bounding the diff by ~2·lr = 4e-4.
+    np.testing.assert_allclose(float(m_par["d_loss"]), float(m_ref["d_loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(m_par["g_loss"]), float(m_ref["g_loss"]),
+                               rtol=1e-5)
+    assert max_abs_diff(s_ref["params"], jax.device_get(s_par["params"])) \
+        <= 2 * cfg.learning_rate + 1e-5
+
+
+def test_sharded_state_placement():
+    cfg = TrainConfig(model=TINY, batch_size=16, mesh=MeshConfig(model=2))
+    pt = make_parallel_train(cfg)
+    state = pt.init(jax.random.key(0))
+    w = state["params"]["gen"]["proj"]["w"]
+    # physically sharded over the model axis: each shard holds 1/2 the columns
+    shard_shapes = {tuple(s.data.shape) for s in w.addressable_shards}
+    assert shard_shapes == {(w.shape[0], w.shape[1] // 2)}
+    step = state["step"]
+    assert all(s.data.shape == () for s in step.addressable_shards)
+
+
+def test_sharded_sample_and_multiple_steps():
+    cfg = TrainConfig(model=TINY, batch_size=16)
+    pt = make_parallel_train(cfg)
+    s = pt.init(jax.random.key(0))
+    xs = real_batch()
+    for i in range(3):
+        s, m = pt.step(s, xs, jax.random.fold_in(jax.random.key(1), i))
+    assert int(s["step"]) == 3
+    z = jax.random.uniform(jax.random.key(2), (16, 100), minval=-1, maxval=1)
+    img = pt.sample(s, z)
+    assert img.shape == (16, 16, 16, 3)
+
+
+def test_conditional_sharded_step():
+    cfg = TrainConfig(
+        model=ModelConfig(output_size=16, gf_dim=8, df_dim=8, num_classes=4,
+                          compute_dtype="float32"),
+        batch_size=16)
+    pt = make_parallel_train(cfg)
+    s = pt.init(jax.random.key(0))
+    y = jnp.arange(16) % 4
+    s, m = pt.step(s, real_batch(), jax.random.key(1), y)
+    assert np.isfinite(float(m["d_loss"]))
+
+
+def test_wgan_gp_sharded():
+    """Grad-of-grad through the GSPMD-sharded mesh (SURVEY.md §7 hard part c)."""
+    cfg = TrainConfig(model=TINY, batch_size=16, loss="wgan-gp")
+    pt = make_parallel_train(cfg)
+    s = pt.init(jax.random.key(0))
+    s, m = pt.step(s, real_batch(), jax.random.key(1))
+    assert np.isfinite(float(m["gp"]))
