@@ -119,7 +119,9 @@ class TrainConfig:
     checkpoint_dir: str = "checkpoint"
     sample_dir: str = "samples"
     save_summaries_secs: float = 10.0
-    save_model_secs: float = 600.0
+    save_model_secs: float = 600.0   # single-process checkpoint cadence
+    save_model_steps: int = 1000     # multi-host cadence (collective save
+                                     # needs a clock-independent trigger)
     sample_every_steps: int = 100
     sample_grid: Tuple[int, int] = (8, 8)   # 8x8 grid (image_train.py:205)
     log_every_steps: int = 1

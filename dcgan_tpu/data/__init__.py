@@ -1,0 +1,15 @@
+"""Input pipeline: TFRecord IO, native threaded loader, device prefetch.
+
+Replaces the reference's queue-runner pipeline (image_input.py) — 16 preprocess
+threads feeding tf.train.shuffle_batch whose batches were then round-tripped
+device→host→device every step (image_train.py:153,158, SURVEY.md §2.4 #10) —
+with a C++ reader/shuffler/batcher feeding sharded jax.Arrays directly, with
+prefetch so the TPU never waits on the host.
+"""
+
+from dcgan_tpu.data.pipeline import DataConfig, make_dataset  # noqa: F401
+from dcgan_tpu.data.synthetic import (  # noqa: F401
+    synthetic_batches,
+    write_image_tfrecords,
+)
+from dcgan_tpu.data.tfrecord import read_tfrecords, write_tfrecords  # noqa: F401

@@ -1,0 +1,147 @@
+"""ctypes bindings for the native loader, with on-demand compilation.
+
+The shared library is built once from data/native/loader.cc with g++ and
+cached next to the source (rebuilt when the source is newer). If no toolchain
+is available the pipeline falls back to the pure-Python loader in
+pipeline.py — same semantics, slower.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "native", "loader.cc")
+_BUILD_DIR = os.path.join(os.path.dirname(__file__), "native", "_build")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+class NativeLoaderError(RuntimeError):
+    pass
+
+
+def _build_library() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    so_path = os.path.join(_BUILD_DIR, f"libdcgan_loader_{tag}.so")
+    if os.path.exists(so_path):
+        return so_path
+    # per-process tmp name: concurrent builders must not clobber each other's
+    # output; os.replace makes the final install atomic either way
+    tmp_path = f"{so_path}.{os.getpid()}.tmp"
+    cmd = ["g++", "-std=c++17", "-O3", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", tmp_path]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired,
+            FileNotFoundError) as e:
+        detail = getattr(e, "stderr", b"")
+        raise NativeLoaderError(
+            f"native loader build failed: {e}\n"
+            f"{detail.decode() if isinstance(detail, bytes) else detail}")
+    os.replace(tmp_path, so_path)
+    return so_path
+
+
+def _get_lib():
+    global _lib
+    with _lib_lock:
+        if _lib is None:
+            lib = ctypes.CDLL(_build_library())
+            lib.dcgan_loader_create.restype = ctypes.c_void_p
+            lib.dcgan_loader_create.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_char_p]
+            lib.dcgan_loader_next.restype = ctypes.c_int
+            lib.dcgan_loader_next.argtypes = [ctypes.c_void_p,
+                                              ctypes.POINTER(ctypes.c_float)]
+            lib.dcgan_loader_error.restype = ctypes.c_char_p
+            lib.dcgan_loader_error.argtypes = [ctypes.c_void_p]
+            lib.dcgan_loader_destroy.restype = None
+            lib.dcgan_loader_destroy.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        return _lib
+
+
+_DTYPE_CODES = {"float64": 0, "float32": 1, "uint8": 2}
+
+
+class NativeLoader:
+    """Threaded shuffle-batch loader over TFRecord shards (see loader.cc)."""
+
+    def __init__(self, paths: Sequence[str], *, batch: int,
+                 example_shape: Sequence[int], record_dtype: str = "float64",
+                 min_after_dequeue: int = 10_776, n_threads: int = 16,
+                 prefetch_batches: int = 4, seed: int = 0,
+                 normalize: bool = True, verify_crc: bool = True,
+                 loop: bool = True, feature_name: str = "image_raw"):
+        if record_dtype not in _DTYPE_CODES:
+            raise ValueError(f"record_dtype must be one of {list(_DTYPE_CODES)}")
+        for p in paths:
+            if not os.path.exists(p):
+                # fail fast like the reference's per-shard existence check
+                # (image_input.py:111-113)
+                raise FileNotFoundError(f"TFRecord shard not found: {p}")
+        self._lib = _get_lib()
+        self.batch = int(batch)
+        self.example_shape = tuple(int(d) for d in example_shape)
+        n_floats = int(np.prod(self.example_shape))
+        c_paths = (ctypes.c_char_p * len(paths))(
+            *[p.encode() for p in paths])
+        self._handle = self._lib.dcgan_loader_create(
+            c_paths, len(paths), self.batch, n_floats,
+            _DTYPE_CODES[record_dtype], int(min_after_dequeue),
+            int(n_threads), int(prefetch_batches), int(seed),
+            int(bool(normalize)), int(bool(verify_crc)), int(bool(loop)),
+            feature_name.encode())
+        if not self._handle:
+            raise NativeLoaderError("loader_create failed")
+        self._out = np.empty((self.batch,) + self.example_shape,
+                             dtype=np.float32)
+
+    def next(self) -> Optional[np.ndarray]:
+        """Next [B, ...] float32 batch, or None at end-of-data (loop=False)."""
+        rc = self._lib.dcgan_loader_next(
+            self._handle,
+            self._out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc == 0:
+            return self._out.copy()
+        if rc == 1:
+            return None
+        raise NativeLoaderError(
+            self._lib.dcgan_loader_error(self._handle).decode())
+
+    def __iter__(self):
+        while True:
+            b = self.next()
+            if b is None:
+                return
+            yield b
+
+    def close(self):
+        if getattr(self, "_handle", None):
+            self._lib.dcgan_loader_destroy(self._handle)
+            self._handle = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,426 @@
+// dcgan_tpu native data loader.
+//
+// TPU-native replacement for the runtime machinery behind the reference's
+// input pipeline (image_input.py): the TFRecordReader op, the 16-thread
+// queue-runner pool feeding tf.train.shuffle_batch (image_input.py:77-84),
+// and the string_input_producer filename queue (image_input.py:115) were all
+// TF-internal native components; this file is their standalone equivalent.
+//
+// Pipeline: reader threads stream TFRecord shards in an endless loop,
+// CRC32C-verify frames, parse the tf.train.Example wire format to extract one
+// bytes feature (default "image_raw", the reference's single-feature schema,
+// image_input.py:42-47), decode float64/float32/uint8 pixels to float32
+// (optionally normalizing to [-1,1] — the fix for SURVEY.md §2.4 #1), push
+// into a uniform-shuffle reservoir (capacity = min_after_dequeue + 3*batch,
+// matching image_input.py:75-76), and assemble contiguous [B,H,W,C] float
+// batches into a bounded prefetch queue consumed via the C API below.
+//
+// Build: g++ -std=c++17 -O3 -shared -fPIC (see native.py); zero dependencies.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <stdio.h>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// CRC32C (software table; Castagnoli reflected poly 0x82F63B78)
+// ---------------------------------------------------------------------------
+
+struct Crc32cTable {
+  uint32_t t[256];
+  Crc32cTable() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t crc = i;
+      for (int k = 0; k < 8; ++k)
+        crc = (crc & 1) ? (crc >> 1) ^ 0x82F63B78u : crc >> 1;
+      t[i] = crc;
+    }
+  }
+};
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+  static const Crc32cTable table;
+  uint32_t crc = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    crc = table.t[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+uint32_t masked_crc32c(const uint8_t* data, size_t n) {
+  uint32_t crc = crc32c(data, n);
+  return ((crc >> 15) | (crc << 17)) + 0xA282EAD8u;
+}
+
+// ---------------------------------------------------------------------------
+// Minimal protobuf wire parsing for tf.train.Example
+// ---------------------------------------------------------------------------
+
+bool read_varint(const uint8_t* buf, size_t len, size_t* pos, uint64_t* out) {
+  uint64_t result = 0;
+  int shift = 0;
+  while (*pos < len) {
+    uint8_t b = buf[(*pos)++];
+    result |= uint64_t(b & 0x7F) << shift;
+    if (!(b & 0x80)) { *out = result; return true; }
+    shift += 7;
+    if (shift > 63) return false;
+  }
+  return false;
+}
+
+struct Slice { const uint8_t* p = nullptr; size_t n = 0; };
+
+// Scan a length-delimited submessage for the first field `field_num` with
+// wire type 2, returning its payload. Returns false if absent/malformed.
+bool find_len_field(Slice msg, uint32_t field_num, Slice* out, size_t* resume) {
+  size_t pos = resume ? *resume : 0;
+  while (pos < msg.n) {
+    uint64_t tag;
+    if (!read_varint(msg.p, msg.n, &pos, &tag)) return false;
+    uint32_t field = uint32_t(tag >> 3), wt = uint32_t(tag & 7);
+    if (wt == 2) {
+      uint64_t len;
+      if (!read_varint(msg.p, msg.n, &pos, &len) || pos + len > msg.n)
+        return false;
+      if (field == field_num) {
+        *out = {msg.p + pos, size_t(len)};
+        if (resume) *resume = pos + len;
+        return true;
+      }
+      pos += len;
+    } else if (wt == 0) {
+      uint64_t v;
+      if (!read_varint(msg.p, msg.n, &pos, &v)) return false;
+    } else if (wt == 1) {
+      pos += 8;
+    } else if (wt == 5) {
+      pos += 4;
+    } else {
+      return false;
+    }
+  }
+  return false;
+}
+
+// Example -> Features(1) -> map entries feature(1) {key(1), value(2)} ->
+// Feature.bytes_list(1).value(1). Returns the first bytes payload whose map
+// key equals `feature_name` (empty name = first bytes feature found).
+bool extract_bytes_feature(Slice example, const std::string& feature_name,
+                           Slice* out) {
+  Slice features;
+  if (!find_len_field(example, 1, &features, nullptr)) return false;
+  size_t resume = 0;
+  Slice entry;
+  while (find_len_field(features, 1, &entry, &resume)) {
+    Slice key{nullptr, 0}, value{nullptr, 0};
+    find_len_field(entry, 1, &key, nullptr);
+    if (!find_len_field(entry, 2, &value, nullptr)) continue;
+    if (!feature_name.empty() &&
+        (key.n != feature_name.size() ||
+         memcmp(key.p, feature_name.data(), key.n) != 0))
+      continue;
+    Slice bytes_list;
+    if (!find_len_field(value, 1, &bytes_list, nullptr)) continue;  // oneof=1
+    Slice payload;
+    if (!find_len_field(bytes_list, 1, &payload, nullptr)) continue;
+    *out = payload;
+    return true;
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------------------
+// Loader
+// ---------------------------------------------------------------------------
+
+enum RecordDtype { DT_F64 = 0, DT_F32 = 1, DT_U8 = 2 };
+
+struct LoaderConfig {
+  std::vector<std::string> paths;
+  int batch = 64;
+  size_t example_floats = 0;   // h*w*c
+  RecordDtype dtype = DT_F64;
+  int min_after_dequeue = 10776;  // 10% of epoch, image_input.py:134-136
+  int n_threads = 16;             // image_input.py:77
+  int prefetch_batches = 4;
+  uint64_t seed = 0;
+  bool normalize = true;          // x/127.5 - 1
+  bool verify_crc = true;
+  std::string feature_name = "image_raw";
+  bool loop = true;               // endless epochs (queue-runner semantics)
+};
+
+class Loader {
+ public:
+  explicit Loader(LoaderConfig cfg) : cfg_(std::move(cfg)), rng_(cfg_.seed) {
+    capacity_ = size_t(cfg_.min_after_dequeue) + 3 * size_t(cfg_.batch);
+    int n = std::max(1, std::min<int>(cfg_.n_threads, int(cfg_.paths.size())));
+    for (int t = 0; t < n; ++t)
+      readers_.emplace_back(&Loader::ReaderLoop, this, t, n);
+    batcher_ = std::thread(&Loader::BatcherLoop, this);
+  }
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    pool_cv_.notify_all();
+    space_cv_.notify_all();
+    batch_cv_.notify_all();
+    for (auto& t : readers_) t.join();
+    batcher_.join();
+  }
+
+  // 0 = ok; 1 = end of data (non-loop mode); -1 = error (see error()).
+  int Next(float* out) {
+    std::unique_lock<std::mutex> lk(mu_);
+    batch_cv_.wait(lk, [&] {
+      return !batches_.empty() || (done_ && pool_.size() < size_t(cfg_.batch))
+             || !error_.empty() || stop_;
+    });
+    if (!error_.empty()) return -1;
+    if (batches_.empty()) return 1;
+    std::vector<float> b = std::move(batches_.front());
+    batches_.pop_front();
+    lk.unlock();
+    space_cv_.notify_one();
+    batch_cv_.notify_all();  // the batcher waits for prefetch space on this cv
+    memcpy(out, b.data(), b.size() * sizeof(float));
+    return 0;
+  }
+
+  const char* error() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return error_.c_str();
+  }
+
+ private:
+  void Fail(const std::string& msg) {
+    std::lock_guard<std::mutex> lk(mu_);
+    if (error_.empty()) error_ = msg;
+    batch_cv_.notify_all();
+  }
+
+  bool DecodeExample(Slice payload, std::vector<float>* out) {
+    const size_t n = cfg_.example_floats;
+    out->resize(n);
+    if (cfg_.dtype == DT_F64) {
+      if (payload.n != n * 8) return false;
+      const double* src = reinterpret_cast<const double*>(payload.p);
+      for (size_t i = 0; i < n; ++i) (*out)[i] = float(src[i]);
+    } else if (cfg_.dtype == DT_F32) {
+      if (payload.n != n * 4) return false;
+      memcpy(out->data(), payload.p, n * 4);
+    } else {
+      if (payload.n != n) return false;
+      for (size_t i = 0; i < n; ++i) (*out)[i] = float(payload.p[i]);
+    }
+    if (cfg_.normalize) {
+      // raw pixel scale [0,255] -> tanh range [-1,1] (the normalization the
+      // reference's trainer comments out, image_train.py:70)
+      for (size_t i = 0; i < n; ++i) (*out)[i] = (*out)[i] / 127.5f - 1.0f;
+    }
+    return true;
+  }
+
+  void PushExample(std::vector<float> ex) {
+    std::unique_lock<std::mutex> lk(mu_);
+    space_cv_.wait(lk, [&] { return pool_.size() < capacity_ || stop_; });
+    if (stop_) return;
+    pool_.push_back(std::move(ex));
+    if (pool_.size() >= size_t(cfg_.min_after_dequeue) ||
+        (done_ && pool_.size() >= size_t(cfg_.batch)))
+      pool_cv_.notify_one();
+  }
+
+  void ReaderLoop(int tid, int n_threads) {
+    std::vector<uint8_t> buf;
+    bool first_pass = true;
+    while (true) {
+      bool read_any = false;
+      for (size_t fi = tid; fi < cfg_.paths.size(); fi += n_threads) {
+        {
+          std::lock_guard<std::mutex> lk(mu_);
+          if (stop_) return;
+        }
+        FILE* f = fopen(cfg_.paths[fi].c_str(), "rb");
+        if (!f) {
+          Fail("cannot open shard: " + cfg_.paths[fi]);
+          return;
+        }
+        uint8_t header[12];
+        while (fread(header, 1, 12, f) == 12) {
+          uint64_t len;
+          memcpy(&len, header, 8);
+          if (cfg_.verify_crc) {
+            uint32_t lcrc;
+            memcpy(&lcrc, header + 8, 4);
+            if (masked_crc32c(header, 8) != lcrc) {
+              Fail("length CRC mismatch in " + cfg_.paths[fi]);
+              fclose(f);
+              return;
+            }
+          }
+          buf.resize(len + 4);
+          if (fread(buf.data(), 1, len + 4, f) != len + 4) {
+            Fail("truncated record in " + cfg_.paths[fi]);
+            fclose(f);
+            return;
+          }
+          if (cfg_.verify_crc) {
+            uint32_t dcrc;
+            memcpy(&dcrc, buf.data() + len, 4);
+            if (masked_crc32c(buf.data(), len) != dcrc) {
+              Fail("data CRC mismatch in " + cfg_.paths[fi]);
+              fclose(f);
+              return;
+            }
+          }
+          Slice payload;
+          if (!extract_bytes_feature({buf.data(), size_t(len)},
+                                     cfg_.feature_name, &payload)) {
+            Fail("record missing feature '" + cfg_.feature_name + "' in " +
+                 cfg_.paths[fi]);
+            fclose(f);
+            return;
+          }
+          std::vector<float> ex;
+          if (!DecodeExample(payload, &ex)) {
+            Fail("bad example payload size in " + cfg_.paths[fi]);
+            fclose(f);
+            return;
+          }
+          read_any = true;
+          PushExample(std::move(ex));
+          {
+            std::lock_guard<std::mutex> lk(mu_);
+            if (stop_) { fclose(f); return; }
+          }
+        }
+        fclose(f);
+      }
+      if (first_pass && !read_any && tid == 0 && cfg_.paths.empty()) {
+        Fail("no shards given");
+        return;
+      }
+      first_pass = false;
+      if (!cfg_.loop) break;
+      if (!read_any) break;  // all assigned shards empty: avoid a spin loop
+    }
+    // non-loop mode: signal completion when the last reader exits
+    std::lock_guard<std::mutex> lk(mu_);
+    if (++readers_done_ == int(readers_.size())) {
+      done_ = true;
+      pool_cv_.notify_all();
+      batch_cv_.notify_all();
+    }
+  }
+
+  void BatcherLoop() {
+    const size_t ex_n = cfg_.example_floats;
+    while (true) {
+      std::vector<std::vector<float>> picked;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        pool_cv_.wait(lk, [&] {
+          return stop_ || !error_.empty() ||
+                 pool_.size() >= size_t(cfg_.min_after_dequeue) + size_t(cfg_.batch) ||
+                 (done_ && pool_.size() >= size_t(cfg_.batch));
+        });
+        if (stop_ || !error_.empty()) return;
+        // uniform shuffle: swap a random element to the back, pop it —
+        // the dequeue-many semantics of tf.train.shuffle_batch
+        for (int i = 0; i < cfg_.batch; ++i) {
+          size_t j = std::uniform_int_distribution<size_t>(
+              0, pool_.size() - 1)(rng_);
+          std::swap(pool_[j], pool_.back());
+          picked.push_back(std::move(pool_.back()));
+          pool_.pop_back();
+        }
+      }
+      space_cv_.notify_all();
+      std::vector<float> batch(size_t(cfg_.batch) * ex_n);
+      for (int i = 0; i < cfg_.batch; ++i)
+        memcpy(batch.data() + size_t(i) * ex_n, picked[i].data(),
+               ex_n * sizeof(float));
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        batch_cv_.wait(lk, [&] {
+          return batches_.size() < size_t(cfg_.prefetch_batches) || stop_;
+        });
+        if (stop_) return;
+        batches_.push_back(std::move(batch));
+      }
+      batch_cv_.notify_all();
+    }
+  }
+
+  LoaderConfig cfg_;
+  size_t capacity_;
+  std::mt19937_64 rng_;
+
+  std::mutex mu_;
+  std::condition_variable pool_cv_, space_cv_, batch_cv_;
+  std::vector<std::vector<float>> pool_;
+  std::deque<std::vector<float>> batches_;
+  std::string error_;
+  bool stop_ = false;
+  bool done_ = false;
+  int readers_done_ = 0;
+
+  std::vector<std::thread> readers_;
+  std::thread batcher_;
+};
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API (ctypes)
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+void* dcgan_loader_create(const char** paths, int n_paths, int batch,
+                          int example_floats, int record_dtype,
+                          int min_after_dequeue, int n_threads,
+                          int prefetch_batches, uint64_t seed, int normalize,
+                          int verify_crc, int loop, const char* feature_name) {
+  LoaderConfig cfg;
+  for (int i = 0; i < n_paths; ++i) cfg.paths.emplace_back(paths[i]);
+  cfg.batch = batch;
+  cfg.example_floats = size_t(example_floats);
+  cfg.dtype = RecordDtype(record_dtype);
+  cfg.min_after_dequeue = min_after_dequeue;
+  cfg.n_threads = n_threads;
+  cfg.prefetch_batches = prefetch_batches;
+  cfg.seed = seed;
+  cfg.normalize = normalize != 0;
+  cfg.verify_crc = verify_crc != 0;
+  cfg.loop = loop != 0;
+  if (feature_name) cfg.feature_name = feature_name;
+  return new Loader(std::move(cfg));
+}
+
+int dcgan_loader_next(void* handle, float* out) {
+  return static_cast<Loader*>(handle)->Next(out);
+}
+
+const char* dcgan_loader_error(void* handle) {
+  return static_cast<Loader*>(handle)->error();
+}
+
+void dcgan_loader_destroy(void* handle) {
+  delete static_cast<Loader*>(handle);
+}
+
+}  // extern "C"

@@ -1,0 +1,255 @@
+"""High-level input pipeline: shards -> shuffled batches -> sharded device
+arrays with prefetch.
+
+The reference's `distorted_inputs(data_dir, batch_size)` (image_input.py:98)
+returned a dequeue op whose batches the trainer then pulled to host and fed
+back per step (image_train.py:153-158 — the device round-trip defect,
+SURVEY.md §2.4 #10). `make_dataset` instead yields jax.Arrays already laid
+out with the training step's batch sharding, one batch ahead (double
+buffering), so the step consumes device-resident data.
+
+Per-host file sharding replaces the reference's "every worker reads every
+file" (image_input.py:107): process i owns shards i, i+P, i+2P, ...
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+import queue
+import random
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from dcgan_tpu.data.example_proto import parse_example
+from dcgan_tpu.data.tfrecord import read_tfrecords
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    """Input knobs (reference: image_input.py:11-16,75-84 and trainer flags)."""
+    data_dir: str = "train"
+    image_size: int = 64
+    channels: int = 3
+    batch_size: int = 64            # per-process batch
+    record_dtype: str = "float64"   # on-disk pixel dtype (image_input.py:48)
+    min_after_dequeue: int = 10_776  # 10% of epoch (image_input.py:134-136)
+    n_threads: int = 16             # (image_input.py:77)
+    prefetch_batches: int = 4
+    seed: int = 0
+    normalize: bool = True          # [-1,1]; False = strict reference parity
+    feature_name: str = "image_raw"
+    use_native: bool = True         # C++ loader; False = pure-Python fallback
+    loop: bool = True
+
+
+def list_shards(data_dir: str) -> List[str]:
+    """Every regular file in data_dir is a shard, as the reference assumes
+    (image_input.py:107)."""
+    paths = sorted(p for p in glob.glob(os.path.join(data_dir, "*"))
+                   if os.path.isfile(p))
+    if not paths:
+        raise FileNotFoundError(f"no TFRecord shards in {data_dir}")
+    return paths
+
+
+def shard_for_process(paths: Sequence[str], process_index: int,
+                      process_count: int) -> List[str]:
+    mine = [p for i, p in enumerate(paths)
+            if i % process_count == process_index]
+    # fewer shards than processes: everyone reads everything, seeds differ
+    return mine or list(paths)
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python loader (fallback / reference implementation for tests)
+# ---------------------------------------------------------------------------
+
+class PythonLoader:
+    """Same contract as native.NativeLoader, implemented with Python threads.
+
+    Reader threads parse shards into a shuffle pool; a batcher assembles
+    batches into a bounded queue.
+    """
+
+    def __init__(self, paths: Sequence[str], *, batch: int,
+                 example_shape: Sequence[int], record_dtype: str = "float64",
+                 min_after_dequeue: int = 1024, n_threads: int = 4,
+                 prefetch_batches: int = 4, seed: int = 0,
+                 normalize: bool = True, loop: bool = True,
+                 feature_name: str = "image_raw"):
+        self.batch = batch
+        self.example_shape = tuple(example_shape)
+        self._paths = list(paths)
+        self._dtype = np.dtype(record_dtype)
+        self._mad = min_after_dequeue
+        # same capacity bound as the native loader (and the reference's queue,
+        # image_input.py:75-76): readers block when the pool is full
+        self._capacity = min_after_dequeue + 3 * batch
+        self._normalize = normalize
+        self._loop = loop
+        self._feature = feature_name
+        self._rng = random.Random(seed)
+        self._pool: List[np.ndarray] = []
+        self._pool_lock = threading.Condition()
+        self._batches: "queue.Queue" = queue.Queue(maxsize=prefetch_batches)
+        self._stop = False
+        self._error: Optional[str] = None
+        self._readers_done = 0
+        n = max(1, min(n_threads, len(self._paths)))
+        self._n_readers = n
+        self._threads = [
+            threading.Thread(target=self._read_loop, args=(t, n), daemon=True)
+            for t in range(n)]
+        self._threads.append(
+            threading.Thread(target=self._batch_loop, daemon=True))
+        for t in self._threads:
+            t.start()
+
+    def _decode(self, payload: bytes) -> np.ndarray:
+        n = int(np.prod(self.example_shape))
+        arr = np.frombuffer(payload, dtype=self._dtype)
+        if arr.size != n:
+            raise ValueError(
+                f"example has {arr.size} values, expected {n}")
+        x = arr.astype(np.float32).reshape(self.example_shape)
+        if self._normalize:
+            x = x / 127.5 - 1.0
+        return x
+
+    def _read_loop(self, tid: int, n_threads: int) -> None:
+        try:
+            while not self._stop:
+                read_any = False
+                for i in range(tid, len(self._paths), n_threads):
+                    for rec in read_tfrecords(self._paths[i]):
+                        feats = parse_example(rec)
+                        if self._feature not in feats:
+                            raise ValueError(
+                                f"record missing feature {self._feature!r}")
+                        x = self._decode(feats[self._feature][0])
+                        read_any = True
+                        with self._pool_lock:
+                            self._pool_lock.wait_for(
+                                lambda: len(self._pool) < self._capacity
+                                or self._stop)
+                            if self._stop:
+                                return
+                            self._pool.append(x)
+                            self._pool_lock.notify_all()
+                if not self._loop or not read_any:
+                    break
+        except Exception as e:  # surface errors to the consumer
+            self._error = str(e)
+        finally:
+            with self._pool_lock:
+                self._readers_done += 1
+                self._pool_lock.notify_all()
+
+    def _batch_loop(self) -> None:
+        while not self._stop:
+            with self._pool_lock:
+                def ready():
+                    done = self._readers_done == self._n_readers
+                    return (self._stop or self._error or
+                            len(self._pool) >= self._mad + self.batch or
+                            (done and len(self._pool) >= self.batch) or
+                            (done and not self._loop))
+                self._pool_lock.wait_for(ready)
+                if self._stop or self._error:
+                    self._batches.put(None)
+                    return
+                if len(self._pool) < self.batch:
+                    self._batches.put(None)  # end of data
+                    return
+                picked = []
+                for _ in range(self.batch):
+                    j = self._rng.randrange(len(self._pool))
+                    self._pool[j], self._pool[-1] = (self._pool[-1],
+                                                     self._pool[j])
+                    picked.append(self._pool.pop())
+                self._pool_lock.notify_all()  # wake readers waiting for space
+            self._batches.put(np.stack(picked))
+
+    def next(self) -> Optional[np.ndarray]:
+        b = self._batches.get()
+        if b is None and self._error:
+            raise RuntimeError(self._error)
+        return b
+
+    def __iter__(self):
+        while True:
+            b = self.next()
+            if b is None:
+                return
+            yield b
+
+    def close(self):
+        self._stop = True
+        with self._pool_lock:
+            self._pool_lock.notify_all()
+        try:
+            while True:
+                self._batches.get_nowait()
+        except queue.Empty:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Device pipeline
+# ---------------------------------------------------------------------------
+
+def _make_loader(cfg: DataConfig, paths: Sequence[str], seed: int):
+    shape = (cfg.image_size, cfg.image_size, cfg.channels)
+    kwargs = dict(batch=cfg.batch_size, example_shape=shape,
+                  record_dtype=cfg.record_dtype,
+                  min_after_dequeue=cfg.min_after_dequeue,
+                  n_threads=cfg.n_threads,
+                  prefetch_batches=cfg.prefetch_batches, seed=seed,
+                  normalize=cfg.normalize, loop=cfg.loop,
+                  feature_name=cfg.feature_name)
+    if cfg.use_native:
+        try:
+            from dcgan_tpu.data.native import NativeLoader
+            return NativeLoader(paths, **kwargs)
+        except Exception as e:
+            import warnings
+            warnings.warn(f"native loader unavailable ({e}); "
+                          "using pure-Python loader")
+    return PythonLoader(paths, **kwargs)
+
+
+def make_dataset(cfg: DataConfig, sharding=None) -> Iterator:
+    """Endless (or one-epoch, cfg.loop=False) iterator of device batches.
+
+    With `sharding` (a NamedSharding over the mesh's data axis), each yielded
+    array is a global array assembled from this process's local batch —
+    cfg.batch_size is the PER-PROCESS batch, and the global batch is
+    batch_size * process_count. Without `sharding`, yields host numpy.
+    """
+    import jax
+
+    paths = shard_for_process(list_shards(cfg.data_dir),
+                              jax.process_index(), jax.process_count())
+    loader = _make_loader(cfg, paths, cfg.seed + jax.process_index())
+
+    if sharding is None:
+        yield from loader
+        return
+
+    def put(batch: np.ndarray):
+        return jax.make_array_from_process_local_data(sharding, batch)
+
+    # double-buffer: keep one device transfer in flight ahead of the consumer
+    it = iter(loader)
+    pending = None
+    for batch in it:
+        nxt = put(batch)
+        if pending is not None:
+            yield pending
+        pending = nxt
+    if pending is not None:
+        yield pending

@@ -1,0 +1,60 @@
+"""Synthetic data + TFRecord dataset writer (tests, smoke runs, tools).
+
+The writer produces shards in the reference's on-disk schema — one bytes
+feature `image_raw` holding raw [H,W,C] pixels, float64 by default
+(image_input.py:42-51) — so the loader path is exercised against the real
+format without needing CelebA on disk.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from dcgan_tpu.data.example_proto import serialize_example
+from dcgan_tpu.data.tfrecord import write_tfrecords
+
+
+def write_image_tfrecords(out_dir: str, *, num_examples: int,
+                          image_size: int = 64, channels: int = 3,
+                          num_shards: int = 2, record_dtype: str = "float64",
+                          seed: int = 0,
+                          feature_name: str = "image_raw") -> List[str]:
+    """Write `num_examples` random images (pixel scale [0,255]) across shards.
+
+    Returns the shard paths.
+    """
+    rng = np.random.default_rng(seed)
+    os.makedirs(out_dir, exist_ok=True)
+    paths = []
+    per_shard = (num_examples + num_shards - 1) // num_shards
+    written = 0
+    for s in range(num_shards):
+        n = min(per_shard, num_examples - written)
+        if n <= 0:
+            break
+
+        def records() -> Iterator[bytes]:
+            for _ in range(n):
+                img = rng.uniform(0, 255,
+                                  size=(image_size, image_size, channels))
+                raw = img.astype(record_dtype).tobytes()
+                yield serialize_example({feature_name: [raw]})
+
+        path = os.path.join(out_dir, f"shard-{s:05d}.tfrecord")
+        write_tfrecords(path, records())
+        paths.append(path)
+        written += n
+    return paths
+
+
+def synthetic_batches(batch_size: int, image_size: int = 64, channels: int = 3,
+                      seed: int = 0) -> Iterator[np.ndarray]:
+    """Endless stream of [-1,1] float32 batches (no disk involved)."""
+    rng = np.random.default_rng(seed)
+    while True:
+        yield np.tanh(rng.normal(
+            size=(batch_size, image_size, image_size, channels))
+        ).astype(np.float32)

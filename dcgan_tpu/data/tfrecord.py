@@ -1,0 +1,100 @@
+"""TFRecord container format: pure-Python reader/writer.
+
+The on-disk format the reference consumes via TF's TFRecordReader op
+(image_input.py:40-41). Implemented from the public spec — each record is:
+
+    uint64 length (little-endian)
+    uint32 masked_crc32c(length_bytes)
+    byte   data[length]
+    uint32 masked_crc32c(data)
+
+with CRC32C (Castagnoli polynomial 0x1EDC6F41, reflected 0x82F63B78) and the
+mask rot(crc, 15) + 0xa282ead8. This module is the slow-but-dependency-free
+path (tests, tools, fallback); the hot path is the C++ loader in
+data/native/ which implements the same format with SSE4.2 crc32 when
+available.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterable, Iterator, List, Optional
+
+_MASK_DELTA = 0xA282EAD8
+_U32 = 0xFFFFFFFF
+
+
+def _make_crc32c_table() -> List[int]:
+    poly = 0x82F63B78  # reflected Castagnoli
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+        table.append(crc)
+    return table
+
+
+_TABLE = _make_crc32c_table()
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    crc = ~crc & _U32
+    for b in data:
+        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return ~crc & _U32
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + _MASK_DELTA) & _U32
+
+
+def write_tfrecords(path: str, records: Iterable[bytes]) -> int:
+    """Write serialized records to `path`. Returns the record count."""
+    n = 0
+    with open(path, "wb") as f:
+        for rec in records:
+            length = struct.pack("<Q", len(rec))
+            f.write(length)
+            f.write(struct.pack("<I", masked_crc32c(length)))
+            f.write(rec)
+            f.write(struct.pack("<I", masked_crc32c(rec)))
+            n += 1
+    return n
+
+
+def read_tfrecords(path: str, *, verify_crc: bool = False) -> Iterator[bytes]:
+    """Yield serialized records from a TFRecord file.
+
+    CRC verification is off by default in this Python path (the C++ loader
+    verifies cheaply with hardware crc32); pass verify_crc=True for tools.
+    """
+    if not os.path.exists(path):
+        # the reference existence-checks every shard up front
+        # (image_input.py:111-113); we fail per-file at open
+        raise FileNotFoundError(f"TFRecord shard not found: {path}")
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise IOError(f"truncated record header in {path}")
+            (length,) = struct.unpack("<Q", header[:8])
+            if verify_crc:
+                (lcrc,) = struct.unpack("<I", header[8:12])
+                if masked_crc32c(header[:8]) != lcrc:
+                    raise IOError(f"length CRC mismatch in {path}")
+            data = f.read(length)
+            if len(data) < length:
+                raise IOError(f"truncated record body in {path}")
+            tail = f.read(4)
+            if len(tail) < 4:
+                raise IOError(f"truncated record CRC in {path}")
+            if verify_crc:
+                (dcrc,) = struct.unpack("<I", tail)
+                if masked_crc32c(data) != dcrc:
+                    raise IOError(f"data CRC mismatch in {path}")
+            yield data

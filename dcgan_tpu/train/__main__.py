@@ -1,0 +1,3 @@
+from dcgan_tpu.train.cli import main
+
+main()

@@ -1,0 +1,112 @@
+"""CLI: the reference's flag surface (image_train.py:10-38) as argparse.
+
+Every live knob of the reference exists here under the same name where
+sensible; flags the reference declared but never read (epoch, train_size,
+image_size, is_train, is_crop, visualize, log_device_placement — SURVEY.md
+§2.3) are intentionally absent, and cluster flags (ps_hosts/worker_hosts/
+job_name/task_index) are replaced by the mesh/multi-host knobs since no
+parameter-server role exists.
+
+    python -m dcgan_tpu.train --data_dir /data/celeba --checkpoint_dir ckpt
+    python -m dcgan_tpu.train --synthetic --max_steps 200   # smoke run
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import pprint
+from typing import List, Optional
+
+from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dcgan_tpu.train",
+        description="TPU-native distributed DCGAN trainer")
+    # optimization (reference defaults: image_train.py:11-14)
+    p.add_argument("--learning_rate", type=float, default=2e-4)
+    p.add_argument("--beta1", type=float, default=0.5)
+    p.add_argument("--batch_size", type=int, default=64,
+                   help="global batch size (sharded over the data axis)")
+    p.add_argument("--max_steps", type=int, default=1_200_000)
+    p.add_argument("--loss", choices=["gan", "wgan-gp"], default="gan")
+    p.add_argument("--update_mode", choices=["sequential", "fused"],
+                   default="sequential")
+    # model (image_train.py:15-18 — wired here, unlike the reference)
+    p.add_argument("--output_size", type=int, default=64)
+    p.add_argument("--c_dim", type=int, default=3)
+    p.add_argument("--z_dim", type=int, default=100)
+    p.add_argument("--gf_dim", type=int, default=64)
+    p.add_argument("--df_dim", type=int, default=64)
+    p.add_argument("--num_classes", type=int, default=0,
+                   help=">0 = class-conditional G/D")
+    # data (image_train.py:19-26)
+    p.add_argument("--dataset", default="celebA")
+    p.add_argument("--data_dir", default="train")
+    p.add_argument("--sample_image_dir", default="sample_data")
+    p.add_argument("--synthetic", action="store_true",
+                   help="train on synthetic data (no shards needed)")
+    p.add_argument("--no_normalize", action="store_true",
+                   help="feed raw pixel scale (strict reference parity, "
+                        "SURVEY.md 2.4 #1)")
+    p.add_argument("--record_dtype", default="float64",
+                   choices=["float64", "float32", "uint8"])
+    # observability / checkpoint (image_train.py:20-21,37,129)
+    p.add_argument("--checkpoint_dir", default="checkpoint")
+    p.add_argument("--sample_dir", default="samples")
+    p.add_argument("--save_summaries_secs", type=float, default=10.0)
+    p.add_argument("--save_model_secs", type=float, default=600.0)
+    p.add_argument("--sample_every_steps", type=int, default=100)
+    # mesh (replaces ps_hosts/worker_hosts/job_name/task_index,
+    # image_train.py:27-36)
+    p.add_argument("--mesh_data", type=int, default=-1,
+                   help="data-parallel axis size (-1 = all devices)")
+    p.add_argument("--mesh_model", type=int, default=1,
+                   help="tensor-parallel axis size")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. cpu for local debug; "
+                        "overrides plugins that pin jax_platforms at startup)")
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> TrainConfig:
+    return TrainConfig(
+        model=ModelConfig(
+            output_size=args.output_size, c_dim=args.c_dim,
+            z_dim=args.z_dim, gf_dim=args.gf_dim, df_dim=args.df_dim,
+            num_classes=args.num_classes),
+        mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
+        learning_rate=args.learning_rate, beta1=args.beta1,
+        batch_size=args.batch_size, max_steps=args.max_steps,
+        loss=args.loss, update_mode=args.update_mode,
+        dataset=args.dataset, data_dir=args.data_dir,
+        sample_image_dir=args.sample_image_dir,
+        record_dtype=args.record_dtype,
+        normalize_inputs=not args.no_normalize,
+        checkpoint_dir=args.checkpoint_dir, sample_dir=args.sample_dir,
+        save_summaries_secs=args.save_summaries_secs,
+        save_model_secs=args.save_model_secs,
+        sample_every_steps=args.sample_every_steps,
+        seed=args.seed)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    cfg = config_from_args(args)
+    # echo the effective config at startup, like the reference's
+    # pp.pprint(FLAGS.__flags) (image_train.py:223)
+    pprint.pprint(dataclasses.asdict(cfg))
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from dcgan_tpu.train.trainer import train
+    train(cfg, synthetic_data=args.synthetic)
+
+
+if __name__ == "__main__":
+    main()

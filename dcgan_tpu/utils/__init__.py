@@ -1,0 +1,5 @@
+"""Aux subsystems: checkpoint/resume, metrics, sample grids, profiling."""
+
+from dcgan_tpu.utils.checkpoint import Checkpointer  # noqa: F401
+from dcgan_tpu.utils.images import image_grid, inverse_transform, save_png  # noqa: F401
+from dcgan_tpu.utils.metrics import MetricWriter, histogram_summary  # noqa: F401

@@ -1,0 +1,99 @@
+"""Checkpoint / resume (Orbax-backed).
+
+The reference's story (SURVEY.md §3.3, §5): a tf.train.Saver over all
+variables (image_train.py:103), Supervisor-driven periodic save every 600 s on
+the chief only (image_train.py:123-129), and restore-latest on startup
+(image_train.py:141-146,233-245). Same contract here over the train-state
+pytree — params, BN running stats, both Adam states, step — with Orbax doing
+sharded, async-capable array IO (each host writes its shards; no PS process
+holds "the" copy).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import jax
+
+Pytree = Any
+
+
+class Checkpointer:
+    """save / maybe_save (time-throttled) / restore_latest over a state pytree.
+
+    Only the chief process drives the save cadence (is_chief gating lives in
+    the trainer, matching the reference's chief-only Supervisor saver), but
+    all processes must enter save() together for multi-host array gather.
+    """
+
+    def __init__(self, directory: str, *, save_interval_secs: float = 600.0,
+                 save_interval_steps: int = 1000, max_to_keep: int = 5,
+                 async_save: bool = True):
+        import os
+
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save))
+        self.save_interval_secs = save_interval_secs
+        self.save_interval_steps = save_interval_steps
+        self._next_save = time.time() + save_interval_secs
+
+    def save(self, step: int, state: Pytree, *, force: bool = False) -> None:
+        self._mgr.save(int(step),
+                       args=self._ocp.args.StandardSave(state),
+                       force=force)
+
+    def maybe_save(self, step: int, state: Pytree) -> bool:
+        """Throttled save — the Supervisor's save_model_secs=600 cadence
+        (image_train.py:129).
+
+        Single-process: wall-clock throttle. Multi-host: save() is a
+        collective, so the decision must be identical on every process —
+        per-process clocks are not, so the cadence switches to the
+        deterministic step interval.
+        """
+        if jax.process_count() > 1:
+            if step % self.save_interval_steps != 0:
+                return False
+        else:
+            now = time.time()
+            if now < self._next_save:
+                return False
+            self._next_save = now + self.save_interval_secs
+        self.save(step, state)
+        return True
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore_latest(self, target_state: Pytree) -> Optional[Pytree]:
+        """Restore the newest checkpoint into the shape/sharding of
+        `target_state` (pass the freshly-initialized state); None if no
+        checkpoint exists — the reference's load() boolean contract
+        (image_train.py:233-245)."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return None
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=getattr(x, "sharding",
+                                                            None))
+            if hasattr(x, "shape") else x,
+            target_state)
+        return self._mgr.restore(
+            step, args=self._ocp.args.StandardRestore(abstract))
+
+    def wait(self) -> None:
+        """Block until async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()

@@ -1,0 +1,52 @@
+"""Sample-grid rendering: tanh-range batches -> tiled PNG.
+
+The reference's save_images/merge/inverse_transform helpers
+(image_train.py:197-219) tiled 64 generator samples into an 8x8 canvas via
+scipy.misc.imsave. Same capability, numpy + PIL, any grid shape.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def inverse_transform(images: np.ndarray) -> np.ndarray:
+    """tanh range [-1,1] -> [0,1] (image_train.py:218-219)."""
+    return (np.asarray(images, dtype=np.float32) + 1.0) / 2.0
+
+
+def image_grid(images: np.ndarray, grid: Tuple[int, int]) -> np.ndarray:
+    """Tile [N,H,W,C] into [rows*H, cols*W, C]; N must fill the grid."""
+    rows, cols = grid
+    images = np.asarray(images)
+    n, h, w, c = images.shape
+    if n < rows * cols:
+        raise ValueError(f"grid {rows}x{cols} needs {rows*cols} images, "
+                         f"got {n}")
+    canvas = np.zeros((rows * h, cols * w, c), dtype=images.dtype)
+    for idx in range(rows * cols):
+        r, col = divmod(idx, cols)
+        canvas[r * h:(r + 1) * h, col * w:(col + 1) * w] = images[idx]
+    return canvas
+
+
+def save_png(path: str, image01: np.ndarray) -> None:
+    """Save a [H,W,C] float image in [0,1] as PNG."""
+    from PIL import Image
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arr = np.clip(np.asarray(image01) * 255.0, 0, 255).astype(np.uint8)
+    if arr.shape[-1] == 1:
+        arr = arr[..., 0]
+    Image.fromarray(arr).save(path)
+
+
+def save_sample_grid(path: str, images: np.ndarray,
+                     grid: Tuple[int, int] = (8, 8)) -> None:
+    """tanh-range samples -> tiled PNG on disk (the reference's
+    `save_images(images, [8,8], './samples/train_{e}_{s}.png')`,
+    image_train.py:188-190)."""
+    save_png(path, image_grid(inverse_transform(images), grid))

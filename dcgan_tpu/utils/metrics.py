@@ -1,0 +1,105 @@
+"""Metrics/observability: scalars, histograms, images -> JSONL events + stdout.
+
+The reference's three channels (SURVEY.md §5): per-step stdout loss logging
+(image_train.py:160-169), TF summaries — activation/variable histograms and
+loss scalars, chief-only, time-throttled to save_summaries_secs=10
+(image_train.py:86-115,155-178) — and periodic PNG sample grids. This module
+provides the first two natively: an append-only JSONL event stream any tool
+can tail, with the same time-throttling contract; grids live in
+utils/images.py.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Mapping, Optional
+
+import numpy as np
+
+
+def histogram_summary(values, bins: int = 30) -> Dict[str, Any]:
+    """Compact histogram record (the replacement for tf.histogram_summary,
+    distriubted_model.py:79): moments + sparsity + binned counts."""
+    arr = np.asarray(values, dtype=np.float32).ravel()
+    counts, edges = np.histogram(arr, bins=bins)
+    return {
+        "count": int(arr.size),
+        "min": float(arr.min()) if arr.size else 0.0,
+        "max": float(arr.max()) if arr.size else 0.0,
+        "mean": float(arr.mean()) if arr.size else 0.0,
+        "std": float(arr.std()) if arr.size else 0.0,
+        # zero_fraction: the reference's per-layer sparsity scalar
+        # (distriubted_model.py:80)
+        "zero_fraction": float(np.mean(arr == 0.0)) if arr.size else 0.0,
+        "bin_edges": [float(e) for e in edges],
+        "bin_counts": [int(c) for c in counts],
+    }
+
+
+class MetricWriter:
+    """Chief-only, time-throttled event writer.
+
+    write_scalars / write_histograms append JSONL events; `every_secs`
+    mirrors the reference's save_summaries_secs gate (image_train.py:37,
+    155-178): ready() flips true at most once per interval.
+    """
+
+    def __init__(self, logdir: str, *, every_secs: float = 10.0,
+                 enabled: bool = True, filename: str = "events.jsonl"):
+        self.logdir = logdir
+        self.every_secs = every_secs
+        self.enabled = enabled
+        self._next_time = 0.0  # first call always fires, like the reference
+        self._path = os.path.join(logdir, filename)
+        if enabled:
+            os.makedirs(logdir, exist_ok=True)
+
+    def ready(self, now: Optional[float] = None) -> bool:
+        if not self.enabled:
+            return False
+        now = time.time() if now is None else now
+        if now >= self._next_time:
+            # advance from *now*, not by accumulation — a slow step shouldn't
+            # cause a burst of catch-up summaries
+            self._next_time = now + self.every_secs
+            return True
+        return False
+
+    def _emit(self, kind: str, step: int, payload: Mapping[str, Any]) -> None:
+        if not self.enabled:
+            return
+        event = {"kind": kind, "step": int(step), "time": time.time(),
+                 **payload}
+        with open(self._path, "a") as f:
+            f.write(json.dumps(event) + "\n")
+
+    def write_scalars(self, step: int, scalars: Mapping[str, Any]) -> None:
+        self._emit("scalars", step,
+                   {"values": {k: float(v) for k, v in scalars.items()}})
+
+    def write_histograms(self, step: int, tensors: Mapping[str, Any],
+                         bins: int = 30) -> None:
+        self._emit("histograms", step,
+                   {"values": {k: histogram_summary(v, bins)
+                               for k, v in tensors.items()}})
+
+    def write_image_event(self, step: int, name: str, path: str) -> None:
+        """Record that an image artifact was written (the grid PNG itself is
+        saved by utils.images)."""
+        self._emit("image", step, {"name": name, "path": path})
+
+
+def param_histograms(params, prefix: str = "") -> Dict[str, np.ndarray]:
+    """Flatten a param pytree into {dotted/path: leaf} for histogram events —
+    the reference histograms every trainable variable (image_train.py:114-115).
+    """
+    import jax
+
+    out: Dict[str, np.ndarray] = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        name = prefix + "/".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        out[name] = np.asarray(leaf)
+    return out

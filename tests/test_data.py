@@ -1,0 +1,186 @@
+"""Input-pipeline tests: TFRecord framing + CRC, Example codec, native C++
+loader vs pure-Python loader, sharded device delivery
+(reference behavior: image_input.py; SURVEY.md §2.2)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dcgan_tpu.data import tfrecord
+from dcgan_tpu.data.example_proto import parse_example, serialize_example
+from dcgan_tpu.data.pipeline import (
+    DataConfig,
+    PythonLoader,
+    list_shards,
+    make_dataset,
+    shard_for_process,
+)
+from dcgan_tpu.data.synthetic import synthetic_batches, write_image_tfrecords
+
+
+class TestTFRecord:
+    def test_crc32c_known_vectors(self):
+        # public CRC32C test vectors
+        assert tfrecord.crc32c(b"") == 0
+        assert tfrecord.crc32c(b"123456789") == 0xE3069283
+        assert tfrecord.crc32c(b"\x00" * 32) == 0x8A9136AA
+
+    def test_roundtrip_with_crc(self, tmp_path):
+        path = str(tmp_path / "x.tfrecord")
+        recs = [b"alpha", b"", b"\x00\xff" * 100]
+        assert tfrecord.write_tfrecords(path, recs) == 3
+        out = list(tfrecord.read_tfrecords(path, verify_crc=True))
+        assert out == recs
+
+    def test_corruption_detected(self, tmp_path):
+        path = str(tmp_path / "x.tfrecord")
+        tfrecord.write_tfrecords(path, [b"payload-payload"])
+        raw = bytearray(open(path, "rb").read())
+        raw[14] ^= 0xFF  # flip a data byte
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(IOError):
+            list(tfrecord.read_tfrecords(path, verify_crc=True))
+
+    def test_missing_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            list(tfrecord.read_tfrecords("/nonexistent/shard"))
+
+
+class TestExampleProto:
+    def test_bytes_roundtrip(self):
+        msg = serialize_example({"image_raw": [b"\x01\x02\x03"]})
+        assert parse_example(msg) == {"image_raw": [b"\x01\x02\x03"]}
+
+    def test_mixed_features(self):
+        msg = serialize_example({
+            "image_raw": [b"pixels"],
+            "label": [3],
+            "scores": [0.5, -1.5],
+        })
+        out = parse_example(msg)
+        assert out["image_raw"] == [b"pixels"]
+        assert out["label"] == [3]
+        np.testing.assert_allclose(out["scores"], [0.5, -1.5])
+
+    def test_cross_check_against_tensorflow(self):
+        """Our codec must interoperate with the real tf.train.Example."""
+        tf = pytest.importorskip("tensorflow")
+        feats = {"image_raw": [b"\x00" * 16], "label": [7]}
+        ours = serialize_example(feats)
+        theirs = tf.train.Example()
+        theirs.ParseFromString(ours)
+        assert theirs.features.feature["image_raw"].bytes_list.value[0] \
+            == b"\x00" * 16
+        assert theirs.features.feature["label"].int64_list.value[0] == 7
+        # and the reverse: parse TF's serialization with our parser
+        assert parse_example(theirs.SerializeToString()) == feats
+
+
+def _write_dataset(tmp_path, n=48, size=8, dtype="float64", shards=3):
+    return write_image_tfrecords(
+        str(tmp_path / "data"), num_examples=n, image_size=size,
+        channels=3, num_shards=shards, record_dtype=dtype)
+
+
+LOADER_KW = dict(batch=16, example_shape=(8, 8, 3), min_after_dequeue=8,
+                 n_threads=3, seed=0, normalize=True, loop=True)
+
+
+class TestLoaders:
+    @pytest.mark.parametrize("dtype", ["float64", "float32", "uint8"])
+    def test_native_loader_batches(self, tmp_path, dtype):
+        native = pytest.importorskip("dcgan_tpu.data.native")
+        paths = _write_dataset(tmp_path, dtype=dtype)
+        with native.NativeLoader(paths, record_dtype=dtype,
+                                 **LOADER_KW) as ld:
+            for _ in range(5):
+                b = ld.next()
+                assert b.shape == (16, 8, 8, 3) and b.dtype == np.float32
+                assert -1.0 <= b.min() and b.max() <= 1.0
+                assert b.std() > 0.1  # actually data, not zeros
+
+    def test_native_missing_feature_error(self, tmp_path):
+        native = pytest.importorskip("dcgan_tpu.data.native")
+        path = str(tmp_path / "bad.tfrecord")
+        tfrecord.write_tfrecords(
+            path, [serialize_example({"other": [b"\x00" * 8]})])
+        with native.NativeLoader([path], **LOADER_KW) as ld:
+            with pytest.raises(native.NativeLoaderError,
+                               match="image_raw"):
+                ld.next()
+
+    def test_native_crc_error(self, tmp_path):
+        native = pytest.importorskip("dcgan_tpu.data.native")
+        paths = _write_dataset(tmp_path, n=4, shards=1)
+        raw = bytearray(open(paths[0], "rb").read())
+        raw[40] ^= 0xFF
+        open(paths[0], "wb").write(bytes(raw))
+        with native.NativeLoader(paths, **LOADER_KW) as ld:
+            with pytest.raises(native.NativeLoaderError, match="CRC"):
+                ld.next()
+
+    def test_python_loader_matches_semantics(self, tmp_path):
+        paths = _write_dataset(tmp_path)
+        ld = PythonLoader(paths, record_dtype="float64", **LOADER_KW)
+        b = ld.next()
+        assert b.shape == (16, 8, 8, 3)
+        assert -1.0 <= b.min() and b.max() <= 1.0
+        ld.close()
+
+    def test_no_normalize_keeps_raw_scale(self, tmp_path):
+        """normalize=False reproduces the reference's raw-pixel feed
+        (SURVEY.md §2.4 #1)."""
+        paths = _write_dataset(tmp_path)
+        kw = dict(LOADER_KW, normalize=False)
+        ld = PythonLoader(paths, record_dtype="float64", **kw)
+        b = ld.next()
+        assert b.max() > 10.0  # raw [0,255] scale
+        ld.close()
+
+    def test_one_epoch_mode(self, tmp_path):
+        paths = _write_dataset(tmp_path, n=40)
+        kw = dict(LOADER_KW, loop=False)
+        ld = PythonLoader(paths, record_dtype="float64", **kw)
+        batches = list(ld)
+        assert len(batches) == 2  # 40 examples -> 2 full batches of 16
+        ld.close()
+
+
+class TestPipeline:
+    def test_shard_for_process(self):
+        paths = [f"s{i}" for i in range(5)]
+        assert shard_for_process(paths, 0, 2) == ["s0", "s2", "s4"]
+        assert shard_for_process(paths, 1, 2) == ["s1", "s3"]
+        # fewer shards than processes: everyone reads everything
+        assert shard_for_process(["s0"], 3, 8) == ["s0"]
+
+    def test_list_shards_empty_dir(self, tmp_path):
+        os.makedirs(tmp_path / "empty", exist_ok=True)
+        with pytest.raises(FileNotFoundError):
+            list_shards(str(tmp_path / "empty"))
+
+    def test_make_dataset_sharded_delivery(self, tmp_path):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from dcgan_tpu.parallel import make_mesh
+        _write_dataset(tmp_path)
+        cfg = DataConfig(data_dir=str(tmp_path / "data"), image_size=8,
+                         batch_size=16, min_after_dequeue=8, n_threads=2,
+                         use_native=True)
+        mesh = make_mesh()
+        sh = NamedSharding(mesh, P("data", None, None, None))
+        it = make_dataset(cfg, sh)
+        b = next(it)
+        assert b.shape == (16, 8, 8, 3)
+        assert b.sharding == sh
+        # each of the 8 data-axis shards holds 2 examples
+        assert {s.data.shape for s in b.addressable_shards} == {(2, 8, 8, 3)}
+        b2 = next(it)
+        assert b2.shape == (16, 8, 8, 3)
+
+    def test_synthetic_batches(self):
+        it = synthetic_batches(4, image_size=8)
+        b = next(it)
+        assert b.shape == (4, 8, 8, 3) and b.dtype == np.float32
+        assert -1.0 <= b.min() and b.max() <= 1.0

@@ -1,0 +1,112 @@
+"""End-to-end trainer tests: CLI parsing, tiny synthetic run with sample grids
++ metrics + checkpointing, and resume-from-checkpoint (SURVEY.md §3.1/§3.3
+call-stack parity)."""
+
+import glob
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from dcgan_tpu.train.cli import build_parser, config_from_args
+from dcgan_tpu.train.trainer import train
+
+
+def tiny_cfg(tmp_path, **kw):
+    base = dict(
+        model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                          compute_dtype="float32"),
+        batch_size=16,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        sample_dir=str(tmp_path / "samples"),
+        sample_grid=(2, 2),
+        sample_size=4,
+        sample_every_steps=3,
+        save_summaries_secs=0.0,   # every loop check fires
+        save_model_secs=1e9,       # only the final forced save
+        log_every_steps=0)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+class TestCLI:
+    def test_defaults_match_reference(self):
+        args = build_parser().parse_args([])
+        cfg = config_from_args(args)
+        assert cfg.learning_rate == 2e-4 and cfg.beta1 == 0.5
+        assert cfg.batch_size == 64 and cfg.max_steps == 1_200_000
+        assert cfg.model.output_size == 64 and cfg.model.z_dim == 100
+        assert cfg.save_summaries_secs == 10.0
+        assert cfg.save_model_secs == 600.0
+
+    def test_overrides(self):
+        args = build_parser().parse_args(
+            ["--output_size", "128", "--loss", "wgan-gp", "--mesh_model", "2",
+             "--no_normalize", "--num_classes", "10"])
+        cfg = config_from_args(args)
+        assert cfg.model.output_size == 128 and cfg.model.num_up_layers == 5
+        assert cfg.loss == "wgan-gp" and cfg.mesh.model == 2
+        assert not cfg.normalize_inputs and cfg.model.num_classes == 10
+
+    def test_bad_flag_rejected(self):
+        with pytest.raises(SystemExit):
+            build_parser().parse_args(["--loss", "hinge"])
+
+
+class TestTrainLoop:
+    def test_synthetic_end_to_end(self, tmp_path):
+        cfg = tiny_cfg(tmp_path)
+        state = train(cfg, synthetic_data=True, max_steps=7)
+        assert int(jax.device_get(state["step"])) == 7
+
+        # sample grids at steps 3 and 6 (2x2 of 8x8 images -> 32x32 PNG)
+        grids = sorted(glob.glob(str(tmp_path / "samples" / "*.png")))
+        assert [os.path.basename(g) for g in grids] == \
+            ["train_00000003.png", "train_00000006.png"]
+        from PIL import Image
+        assert np.asarray(Image.open(grids[0])).shape == (32, 32, 3)
+
+        # metric events written
+        events = [json.loads(l) for l in
+                  open(tmp_path / "ckpt" / "events.jsonl").read().splitlines()]
+        kinds = {e["kind"] for e in events}
+        assert "scalars" in kinds and "histograms" in kinds and "image" in kinds
+        scalar_steps = [e["step"] for e in events if e["kind"] == "scalars"]
+        assert scalar_steps[0] == 1
+
+        # final checkpoint exists at step 7
+        from dcgan_tpu.utils.checkpoint import Checkpointer
+        assert Checkpointer(cfg.checkpoint_dir).latest_step() == 7
+
+    def test_resume_from_checkpoint(self, tmp_path):
+        cfg = tiny_cfg(tmp_path, sample_every_steps=0)
+        train(cfg, synthetic_data=True, max_steps=4)
+        # second invocation restores step 4 and continues to 6
+        state = train(cfg, synthetic_data=True, max_steps=6)
+        assert int(jax.device_get(state["step"])) == 6
+
+    def test_conditional_loop(self, tmp_path):
+        cfg = tiny_cfg(
+            tmp_path,
+            model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                              num_classes=4, compute_dtype="float32"),
+            sample_every_steps=2)
+        state = train(cfg, synthetic_data=True, max_steps=2)
+        assert int(jax.device_get(state["step"])) == 2
+        assert glob.glob(str(tmp_path / "samples" / "*.png"))
+
+    def test_real_tfrecord_pipeline_end_to_end(self, tmp_path):
+        """Full slice: shards on disk -> native loader -> sharded arrays ->
+        sharded train step (the reference's worker call stack, SURVEY.md §3.1,
+        minus the ps role)."""
+        from dcgan_tpu.data.synthetic import write_image_tfrecords
+        write_image_tfrecords(str(tmp_path / "data"), num_examples=64,
+                              image_size=16, num_shards=2)
+        cfg = tiny_cfg(tmp_path, data_dir=str(tmp_path / "data"),
+                       shuffle_buffer=16, num_loader_threads=2,
+                       sample_every_steps=0)
+        state = train(cfg, max_steps=3)
+        assert int(jax.device_get(state["step"])) == 3

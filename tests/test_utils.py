@@ -1,0 +1,115 @@
+"""Utils tests: image grids, metric writer throttling, checkpoint round-trip."""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcgan_tpu.utils.checkpoint import Checkpointer
+from dcgan_tpu.utils.images import (
+    image_grid,
+    inverse_transform,
+    save_png,
+    save_sample_grid,
+)
+from dcgan_tpu.utils.metrics import (
+    MetricWriter,
+    histogram_summary,
+    param_histograms,
+)
+
+
+class TestImages:
+    def test_inverse_transform(self):
+        np.testing.assert_allclose(
+            inverse_transform(np.array([-1.0, 0.0, 1.0])), [0.0, 0.5, 1.0])
+
+    def test_grid_tiling(self):
+        imgs = np.stack([np.full((4, 4, 3), i, np.float32) for i in range(6)])
+        g = image_grid(imgs, (2, 3))
+        assert g.shape == (8, 12, 3)
+        assert g[0, 0, 0] == 0 and g[0, 5, 0] == 1 and g[4, 0, 0] == 3
+
+    def test_grid_too_few_images(self):
+        with pytest.raises(ValueError):
+            image_grid(np.zeros((3, 4, 4, 3)), (2, 2))
+
+    def test_save_sample_grid_roundtrip(self, tmp_path):
+        from PIL import Image
+        path = str(tmp_path / "grid.png")
+        imgs = np.random.default_rng(0).uniform(
+            -1, 1, size=(64, 8, 8, 3)).astype(np.float32)
+        save_sample_grid(path, imgs, (8, 8))
+        arr = np.asarray(Image.open(path))
+        assert arr.shape == (64, 64, 3)
+        # pixel values match the inverse transform of the first tile
+        expect = np.clip(inverse_transform(imgs[0]) * 255, 0, 255).astype(np.uint8)
+        np.testing.assert_array_equal(arr[:8, :8], expect)
+
+
+class TestMetrics:
+    def test_histogram_summary(self):
+        h = histogram_summary(np.array([0.0, 0.0, 1.0, -1.0]), bins=4)
+        assert h["count"] == 4 and h["zero_fraction"] == 0.5
+        assert sum(h["bin_counts"]) == 4
+
+    def test_writer_throttling_and_events(self, tmp_path):
+        w = MetricWriter(str(tmp_path), every_secs=1000.0)
+        assert w.ready()        # first call fires immediately
+        assert not w.ready()    # throttled afterwards
+        w.write_scalars(5, {"d_loss": 1.5, "g_loss": jnp.float32(0.5)})
+        w.write_histograms(5, {"w": np.arange(10.0)})
+        w.write_image_event(5, "samples", "x.png")
+        events = [json.loads(l) for l in
+                  open(tmp_path / "events.jsonl").read().splitlines()]
+        assert [e["kind"] for e in events] == ["scalars", "histograms", "image"]
+        assert events[0]["values"]["d_loss"] == 1.5
+        assert events[1]["values"]["w"]["count"] == 10
+
+    def test_disabled_writer_writes_nothing(self, tmp_path):
+        w = MetricWriter(str(tmp_path / "sub"), enabled=False)
+        assert not w.ready()
+        w.write_scalars(0, {"x": 1.0})
+        assert not os.path.exists(tmp_path / "sub")
+
+    def test_param_histograms_paths(self):
+        tree = {"gen": {"conv0": {"w": np.zeros((2, 2))}}}
+        out = param_histograms(tree)
+        assert list(out) == ["gen/conv0/w"]
+
+
+class TestCheckpoint:
+    def test_save_restore_roundtrip(self, tmp_path):
+        state = {
+            "params": {"w": jnp.arange(6.0).reshape(2, 3)},
+            "step": jnp.int32(7),
+        }
+        ck = Checkpointer(str(tmp_path / "ckpt"), async_save=False)
+        ck.save(7, state)
+        ck.wait()
+        assert ck.latest_step() == 7
+
+        target = jax.tree_util.tree_map(jnp.zeros_like, state)
+        restored = Checkpointer(str(tmp_path / "ckpt"),
+                                async_save=False).restore_latest(target)
+        np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                      np.asarray(state["params"]["w"]))
+        assert int(restored["step"]) == 7
+
+    def test_restore_without_checkpoint_returns_none(self, tmp_path):
+        ck = Checkpointer(str(tmp_path / "empty"), async_save=False)
+        assert ck.restore_latest({"x": jnp.zeros(())}) is None
+
+    def test_maybe_save_throttles(self, tmp_path):
+        ck = Checkpointer(str(tmp_path / "ckpt"), save_interval_secs=1000.0,
+                          async_save=False)
+        state = {"x": jnp.zeros(())}
+        assert not ck.maybe_save(1, state)  # inside the first interval
+        ck._next_save = time.time() - 1     # force the interval boundary
+        assert ck.maybe_save(2, state)
+        ck.wait()
+        assert ck.latest_step() == 2
