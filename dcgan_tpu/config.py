@@ -126,6 +126,13 @@ class TrainConfig:
     sample_grid: Tuple[int, int] = (8, 8)   # 8x8 grid (image_train.py:205)
     log_every_steps: int = 1
 
+    # Profiling (SURVEY.md §5 — the reference has none; jax.profiler + step
+    # timing is the named TPU-native equivalent)
+    profile_dir: str = ""          # non-empty enables trace capture
+    profile_start_step: int = 10   # skip compile + warmup steps
+    profile_num_steps: int = 5
+    timing_window: int = 50        # sliding window for step-time stats
+
     # Misc
     seed: int = 0
     sample_size: int = 64          # fixed-z sample batch (image_train.py:43)

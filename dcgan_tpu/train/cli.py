@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save_summaries_secs", type=float, default=10.0)
     p.add_argument("--save_model_secs", type=float, default=600.0)
     p.add_argument("--sample_every_steps", type=int, default=100)
+    # profiling (SURVEY.md §5 — trace capture the reference never had)
+    p.add_argument("--profile_dir", default="",
+                   help="capture a jax.profiler trace into this dir")
+    p.add_argument("--profile_start_step", type=int, default=10)
+    p.add_argument("--profile_num_steps", type=int, default=5)
+    p.add_argument("--timing_window", type=int, default=50,
+                   help="sliding window (steps) for step-time stats")
     # mesh (replaces ps_hosts/worker_hosts/job_name/task_index,
     # image_train.py:27-36)
     p.add_argument("--mesh_data", type=int, default=-1,
@@ -90,6 +97,10 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         save_summaries_secs=args.save_summaries_secs,
         save_model_secs=args.save_model_secs,
         sample_every_steps=args.sample_every_steps,
+        profile_dir=args.profile_dir,
+        profile_start_step=args.profile_start_step,
+        profile_num_steps=args.profile_num_steps,
+        timing_window=args.timing_window,
         seed=args.seed)
 
 

@@ -41,6 +41,7 @@ from dcgan_tpu.parallel import (
 from dcgan_tpu.utils.checkpoint import Checkpointer
 from dcgan_tpu.utils.images import save_sample_grid
 from dcgan_tpu.utils.metrics import MetricWriter, param_histograms
+from dcgan_tpu.utils.profiling import StepTimer, TraceCapture
 
 Pytree = Any
 
@@ -122,11 +123,17 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
     start_step = int(jax.device_get(state["step"]))
     t_start = time.time()
     metrics = {}
+    timer = StepTimer(window=cfg.timing_window,
+                      images_per_step=cfg.batch_size)
+    trace = TraceCapture(cfg.profile_dir,
+                         start_step=start_step + cfg.profile_start_step,
+                         num_steps=cfg.profile_num_steps)
 
     # step_num is tracked on the host (it equals state["step"], which the
     # trainer fully determines) — touching the device array every iteration
     # would force a per-step host sync and serialize the pipeline.
     for step_num in range(start_step, total_steps):
+        trace.maybe_start(step_num)
         images = next(data)
         key = jax.random.fold_in(base_key, step_num)
         if labels_iter is not None:
@@ -142,10 +149,15 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
             print(f"[dcgan_tpu] epoch {epoch} step {new_step} "
                   f"time {time.time() - t_start:.1f}s "
                   f"d_loss {m['d_loss']:.4f} g_loss {m['g_loss']:.4f}")
+        # With per-step logging (the default, matching the reference's
+        # every-step stdout log) the float() sync above makes this true step
+        # latency; with log_every_steps=0 it measures dispatch cadence only.
+        timer.tick()
 
         if chief and writer.ready():
             writer.write_scalars(new_step,
-                                 {k: float(v) for k, v in metrics.items()})
+                                 {**{k: float(v) for k, v in metrics.items()},
+                                  **timer.summary()})
             writer.write_histograms(
                 new_step, param_histograms(jax.device_get(state["params"])))
 
@@ -159,8 +171,10 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
                 save_sample_grid(path, imgs[:rows * cols], (rows, cols))
                 writer.write_image_event(new_step, "samples", path)
 
+        trace.maybe_stop(new_step, sync=metrics)
         ckpt.maybe_save(new_step, state)
 
+    trace.close()
     ckpt.save(total_steps, state, force=True)
     ckpt.wait()
     return state

@@ -1,0 +1,115 @@
+"""Tracing / profiling: per-step timing stats + jax.profiler trace capture.
+
+The reference has no tracing or profiling subsystem (SURVEY.md §5); its nearest
+artifacts are the elapsed-time stamp in the per-step log (image_train.py:148,162)
+and the dead `log_device_placement` flag (image_train.py:36). SURVEY.md names
+the TPU-native equivalent explicitly — "jax.profiler trace capture + per-step
+timing" — and this module is it:
+
+- `StepTimer`: rolling per-step wall-time statistics (mean/p50/p90/max,
+  steps/sec, images/sec) over a sliding window, emitted through the
+  MetricWriter alongside the loss scalars.
+- `TraceCapture`: captures a jax.profiler trace (XLA device + host timelines,
+  viewable in TensorBoard/Perfetto) for a configured window of steps, e.g.
+  steps [10, 15) once compilation has settled.
+
+Timing caveat: step dispatch is async; host-side wall time per step is only
+meaningful when something syncs the host to the device each iteration. The
+trainer's per-step metric logging (float() on the loss scalars) provides that
+sync, so the timer measures true steady-state step latency including data-feed
+time — which is the point: a rising step time with constant device time is the
+input-bound signature (the reference's own pathology, SURVEY.md §2.4 #10).
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Dict, Optional
+
+
+class StepTimer:
+    """Sliding-window wall-time stats for the training hot loop."""
+
+    def __init__(self, *, window: int = 50,
+                 images_per_step: Optional[int] = None):
+        self.window = window
+        self.images_per_step = images_per_step
+        self._durations: collections.deque = collections.deque(maxlen=window)
+        self._last: Optional[float] = None
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """Mark the end of one step; the first call only arms the timer."""
+        now = time.perf_counter() if now is None else now
+        if self._last is not None:
+            self._durations.append(now - self._last)
+        self._last = now
+
+    def __len__(self) -> int:
+        return len(self._durations)
+
+    def summary(self, prefix: str = "perf/") -> Dict[str, float]:
+        """Stats over the current window; empty dict until 2+ ticks."""
+        if not self._durations:
+            return {}
+        ds = sorted(self._durations)
+        n = len(ds)
+        mean = sum(ds) / n
+        out = {
+            f"{prefix}step_ms_mean": 1e3 * mean,
+            f"{prefix}step_ms_p50": 1e3 * ds[n // 2],
+            f"{prefix}step_ms_p90": 1e3 * ds[min(n - 1, (9 * n) // 10)],
+            f"{prefix}step_ms_max": 1e3 * ds[-1],
+            f"{prefix}steps_per_sec": 1.0 / mean if mean > 0 else 0.0,
+        }
+        if self.images_per_step and mean > 0:
+            out[f"{prefix}images_per_sec"] = self.images_per_step / mean
+        return out
+
+
+class TraceCapture:
+    """One-shot jax.profiler capture over steps [start_step, start_step+num).
+
+    Call maybe_start(step) before dispatching the step and maybe_stop(step)
+    after it; the capture brackets exactly `num_steps` steps. Inactive (and
+    free) when logdir is empty.
+    """
+
+    def __init__(self, logdir: str, *, start_step: int = 10,
+                 num_steps: int = 5):
+        self.logdir = logdir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+        self._done = not logdir or num_steps <= 0
+
+    def maybe_start(self, step: int) -> None:
+        if self._done or self._active or step < self.start_step:
+            return
+        import jax
+
+        jax.profiler.start_trace(self.logdir)
+        self._active = True
+
+    def maybe_stop(self, step: int, sync=None) -> None:
+        """`step` is the number of steps completed so far; pass the step's
+        outputs as `sync` so the trace contains the device execution, not just
+        its dispatch (the train step is pure, so only blocking on its results
+        guarantees completion)."""
+        if not self._active or step < self.stop_step:
+            return
+        import jax
+
+        if sync is not None:
+            jax.block_until_ready(sync)
+        jax.profiler.stop_trace()
+        self._active = False
+        self._done = True
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True

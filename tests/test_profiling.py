@@ -1,0 +1,110 @@
+"""Profiling subsystem: StepTimer math, TraceCapture lifecycle, and the
+trainer wiring (SURVEY.md §5 — the tracing/profiling channel the reference
+lacks entirely)."""
+
+import glob
+import json
+
+import jax
+
+from dcgan_tpu.utils.profiling import StepTimer, TraceCapture
+
+
+class TestStepTimer:
+    def test_empty_until_two_ticks(self):
+        t = StepTimer()
+        assert t.summary() == {}
+        t.tick(now=1.0)
+        assert t.summary() == {}
+        t.tick(now=1.5)
+        assert len(t) == 1
+
+    def test_stats(self):
+        t = StepTimer(window=10, images_per_step=64)
+        for now in [0.0, 0.1, 0.2, 0.3, 0.4]:  # 4 steps of 100ms
+            t.tick(now=now)
+        s = t.summary()
+        assert abs(s["perf/step_ms_mean"] - 100.0) < 1e-6
+        assert abs(s["perf/step_ms_p50"] - 100.0) < 1e-6
+        assert abs(s["perf/steps_per_sec"] - 10.0) < 1e-6
+        assert abs(s["perf/images_per_sec"] - 640.0) < 1e-6
+
+    def test_window_slides(self):
+        t = StepTimer(window=2)
+        t.tick(now=0.0)
+        t.tick(now=10.0)   # slow step, should age out
+        t.tick(now=10.1)
+        t.tick(now=10.2)
+        assert abs(t.summary()["perf/step_ms_max"] - 100.0) < 1e-3
+
+    def test_p90_on_skewed_window(self):
+        t = StepTimer(window=20)
+        now = 0.0
+        t.tick(now=now)
+        for _ in range(19):
+            now += 0.010
+            t.tick(now=now)
+        now += 1.0  # one straggler
+        t.tick(now=now)
+        s = t.summary()
+        assert s["perf/step_ms_p50"] < 20.0
+        assert s["perf/step_ms_max"] > 900.0
+
+
+class TestTraceCapture:
+    def test_disabled_when_no_logdir(self):
+        tc = TraceCapture("", start_step=0, num_steps=5)
+        tc.maybe_start(0)
+        assert not tc._active
+        tc.maybe_stop(10)  # no-op, must not raise
+
+    def test_capture_window(self, tmp_path):
+        logdir = str(tmp_path / "trace")
+        tc = TraceCapture(logdir, start_step=2, num_steps=2)
+        f = jax.jit(lambda x: x * 2.0)
+
+        for step in range(5):
+            tc.maybe_start(step)
+            y = f(jax.numpy.ones((8,)))
+            if step < 2:
+                assert not tc._active
+            tc.maybe_stop(step + 1)
+        y.block_until_ready()
+        assert tc._done and not tc._active
+        # profiler wrote its event files under the logdir
+        assert glob.glob(logdir + "/**/*", recursive=True)
+
+    def test_close_stops_open_trace(self, tmp_path):
+        tc = TraceCapture(str(tmp_path / "t"), start_step=0, num_steps=100)
+        tc.maybe_start(0)
+        assert tc._active
+        tc.close()
+        assert not tc._active
+
+
+class TestTrainerWiring:
+    def test_trainer_emits_perf_scalars_and_trace(self, tmp_path):
+        from dcgan_tpu.config import ModelConfig, TrainConfig
+        from dcgan_tpu.train.trainer import train
+
+        cfg = TrainConfig(
+            model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                              compute_dtype="float32"),
+            batch_size=8,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            sample_dir=str(tmp_path / "samples"),
+            sample_every_steps=0,
+            save_summaries_secs=0.0,
+            save_model_secs=1e9,
+            log_every_steps=1,
+            profile_dir=str(tmp_path / "trace"),
+            profile_start_step=1,
+            profile_num_steps=2)
+        train(cfg, synthetic_data=True, max_steps=5)
+
+        events = [json.loads(l) for l in
+                  open(tmp_path / "ckpt" / "events.jsonl").read().splitlines()]
+        perf_keys = [k for e in events if e["kind"] == "scalars"
+                     for k in e["values"] if k.startswith("perf/")]
+        assert "perf/images_per_sec" in perf_keys
+        assert glob.glob(str(tmp_path / "trace") + "/**/*", recursive=True)
