@@ -1,0 +1,25 @@
+"""Evaluation rig: FID-50k scoring of generator checkpoints (SURVEY.md §7
+phase 8 — the benchmark component the reference never had; its only built-in
+quality signal was eyeballing fixed-z sample grids, image_train.py:179-192).
+"""
+
+from dcgan_tpu.evals.features import (
+    make_npz_feature_fn,
+    make_random_feature_fn,
+)
+from dcgan_tpu.evals.fid import StreamingStats, frechet_distance
+from dcgan_tpu.evals.job import (
+    compute_fid,
+    generator_stats,
+    stats_from_batches,
+)
+
+__all__ = [
+    "StreamingStats",
+    "frechet_distance",
+    "make_npz_feature_fn",
+    "make_random_feature_fn",
+    "stats_from_batches",
+    "generator_stats",
+    "compute_fid",
+]

@@ -1,0 +1,107 @@
+"""FID eval CLI: score a trained checkpoint against a dataset.
+
+    python -m dcgan_tpu.evals --checkpoint_dir ckpt --data_dir /data/celeba
+    python -m dcgan_tpu.evals --checkpoint_dir ckpt --synthetic \
+        --num_samples 1024 --platform cpu        # smoke run
+
+Prints one JSON line: {"fid": ..., "num_samples": ..., "feature_dim": ...}.
+There is no counterpart in the reference — its only eval was the human
+eyeballing the sample grids (SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import List, Optional
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dcgan_tpu.evals",
+                                description="FID scoring of a checkpoint")
+    p.add_argument("--checkpoint_dir", required=True)
+    p.add_argument("--data_dir", default=None,
+                   help="TFRecord shards of real images")
+    p.add_argument("--synthetic", action="store_true",
+                   help="score against the synthetic data stream")
+    p.add_argument("--num_samples", type=int, default=50_000)
+    p.add_argument("--batch_size", type=int, default=256)
+    p.add_argument("--output_size", type=int, default=64)
+    p.add_argument("--c_dim", type=int, default=3)
+    p.add_argument("--z_dim", type=int, default=100)
+    p.add_argument("--gf_dim", type=int, default=64)
+    p.add_argument("--df_dim", type=int, default=64)
+    p.add_argument("--num_classes", type=int, default=0)
+    p.add_argument("--feature_npz", default=None,
+                   help="optional trained embedder weights (evals/features.py)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None)
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    if not args.synthetic and not args.data_dir:
+        raise SystemExit("need --data_dir or --synthetic")
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from dcgan_tpu.config import ModelConfig, TrainConfig
+    from dcgan_tpu.evals.features import make_npz_feature_fn
+    from dcgan_tpu.evals.job import compute_fid
+    from dcgan_tpu.parallel import batch_sharding, make_mesh, \
+        make_parallel_train
+    from dcgan_tpu.utils.checkpoint import Checkpointer
+
+    cfg = TrainConfig(
+        model=ModelConfig(output_size=args.output_size, c_dim=args.c_dim,
+                          z_dim=args.z_dim, gf_dim=args.gf_dim,
+                          df_dim=args.df_dim, num_classes=args.num_classes),
+        batch_size=args.batch_size,
+        checkpoint_dir=args.checkpoint_dir)
+    mesh = make_mesh(cfg.mesh)
+    pt = make_parallel_train(cfg, mesh)
+
+    state = pt.init(jax.random.key(0))
+    restored = Checkpointer(args.checkpoint_dir).restore_latest(state)
+    if restored is None:
+        raise SystemExit(f"no checkpoint under {args.checkpoint_dir}")
+    state = restored
+    step = int(jax.device_get(state["step"]))
+
+    if args.synthetic:
+        from dcgan_tpu.data import synthetic_batches
+
+        data = synthetic_batches(args.batch_size, args.output_size,
+                                 args.c_dim, seed=args.seed + 1)
+    else:
+        from dcgan_tpu.data import DataConfig, make_dataset
+
+        dcfg = DataConfig(data_dir=args.data_dir,
+                          image_size=args.output_size, channels=args.c_dim,
+                          batch_size=args.batch_size, seed=args.seed,
+                          normalize=True)
+        data = make_dataset(dcfg, batch_sharding(mesh, 4))
+
+    feature_fn = feature_dim = None
+    if args.feature_npz:
+        feature_fn, feature_dim = make_npz_feature_fn(args.feature_npz)
+
+    def sample_fn(z, labels=None):
+        return pt.sample(state, z, labels) if labels is not None \
+            else pt.sample(state, z)
+
+    result = compute_fid(
+        sample_fn, data, image_size=args.output_size, c_dim=args.c_dim,
+        z_dim=args.z_dim, num_samples=args.num_samples,
+        batch_size=args.batch_size, num_classes=args.num_classes,
+        seed=args.seed, feature_fn=feature_fn, feature_dim=feature_dim)
+    result["step"] = step
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+"""The FID eval job: stream real-data and generator features into statistics,
+score the Fréchet distance (BASELINE.md north star: FID-50k parity).
+
+Layout mirrors the training driver: the sampler is the mesh-sharded
+`ParallelTrain.sample` (generation fans out over the data axis), features are
+extracted on device batch-by-batch, and only [D] / [D, D] statistics live on
+host. 50k samples at batch 256 is ~200 device round trips of [B, D] floats —
+negligible next to generation itself.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Iterator, Optional, Tuple
+
+import jax
+import numpy as np
+
+from dcgan_tpu.evals.features import FeatureFn, make_random_feature_fn
+from dcgan_tpu.evals.fid import StreamingStats, frechet_distance
+
+
+def stats_from_batches(feature_fn: FeatureFn, batches: Iterable,
+                       num_examples: int, feature_dim: int) -> StreamingStats:
+    """Fold image batches ([B,H,W,C] in [-1,1]) into feature statistics until
+    `num_examples` have been consumed; the last batch is trimmed to land
+    exactly on the target count."""
+    stats = StreamingStats(feature_dim)
+    for batch in batches:
+        take = min(int(batch.shape[0]), num_examples - stats.n)
+        feats = jax.device_get(feature_fn(batch[:take]))
+        stats.update(feats)
+        if stats.n >= num_examples:
+            break
+    if stats.n < num_examples:
+        raise ValueError(
+            f"data stream exhausted at {stats.n}/{num_examples} examples")
+    return stats
+
+
+def generator_stats(sample_fn: Callable, feature_fn: FeatureFn,
+                    feature_dim: int, *, num_samples: int, batch_size: int,
+                    z_dim: int, seed: int = 0,
+                    num_classes: int = 0) -> StreamingStats:
+    """Stream `num_samples` generated images into feature statistics.
+
+    `sample_fn(z[, labels]) -> images` is the EMA-stat sampler path
+    (ParallelTrain.sample / sampler_apply). z is drawn U(-1,1) like training
+    (image_train.py:151); labels cycle through the classes when conditional.
+    """
+    stats = StreamingStats(feature_dim)
+    base = jax.random.key(seed)
+    i = 0
+    while stats.n < num_samples:
+        z = jax.random.uniform(jax.random.fold_in(base, i),
+                               (batch_size, z_dim), minval=-1.0, maxval=1.0)
+        if num_classes:
+            labels = (np.arange(i * batch_size, (i + 1) * batch_size)
+                      % num_classes)
+            images = sample_fn(z, jax.numpy.asarray(labels))
+        else:
+            images = sample_fn(z)
+        take = min(batch_size, num_samples - stats.n)
+        feats = jax.device_get(feature_fn(images[:take]))
+        stats.update(feats)
+        i += 1
+    return stats
+
+
+def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
+                image_size: int, c_dim: int = 3, z_dim: int = 100,
+                num_samples: int = 50_000, batch_size: int = 256,
+                num_classes: int = 0, seed: int = 0,
+                feature_fn: Optional[FeatureFn] = None,
+                feature_dim: Optional[int] = None) -> dict:
+    """End-to-end FID: returns {"fid", "num_samples", "feature_dim"}.
+
+    With feature_fn=None the fixed-seed random embedder is used — scores are
+    then comparable across runs/processes but are surrogate-FID, not
+    Inception-FID (see evals/features.py).
+    """
+    if feature_fn is None:
+        feature_fn, feature_dim = make_random_feature_fn(image_size, c_dim)
+    elif feature_dim is None:
+        raise ValueError("feature_dim required with a custom feature_fn")
+
+    real = stats_from_batches(feature_fn, data_batches, num_samples,
+                              feature_dim)
+    fake = generator_stats(sample_fn, feature_fn, feature_dim,
+                           num_samples=num_samples, batch_size=batch_size,
+                           z_dim=z_dim, seed=seed, num_classes=num_classes)
+    fid = frechet_distance(*real.finalize(), *fake.finalize())
+    return {"fid": fid, "num_samples": num_samples,
+            "feature_dim": feature_dim}

@@ -1,0 +1,195 @@
+"""FID rig: streaming-stat correctness vs numpy, Fréchet closed forms,
+feature-extractor determinism, and the end-to-end eval job (SURVEY.md §7
+phase 8)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcgan_tpu.evals import (
+    StreamingStats,
+    compute_fid,
+    frechet_distance,
+    generator_stats,
+    make_npz_feature_fn,
+    make_random_feature_fn,
+    stats_from_batches,
+)
+
+
+class TestStreamingStats:
+    def test_matches_numpy_mean_cov(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(500, 7))
+        stats = StreamingStats(7)
+        for chunk in np.array_split(x, 9):  # uneven chunks
+            stats.update(chunk)
+        mu, cov = stats.finalize()
+        np.testing.assert_allclose(mu, x.mean(axis=0), atol=1e-10)
+        np.testing.assert_allclose(cov, np.cov(x, rowvar=False), atol=1e-10)
+
+    def test_merge_equals_single_pass(self):
+        rng = np.random.default_rng(1)
+        a, b = rng.normal(size=(100, 4)), rng.normal(size=(150, 4))
+        s1 = StreamingStats(4)
+        s1.update(a)
+        s2 = StreamingStats(4)
+        s2.update(b)
+        s1.merge(s2)
+        mu, cov = s1.finalize()
+        full = np.concatenate([a, b])
+        np.testing.assert_allclose(mu, full.mean(axis=0), atol=1e-10)
+        np.testing.assert_allclose(cov, np.cov(full, rowvar=False), atol=1e-10)
+
+    def test_shape_and_count_validation(self):
+        s = StreamingStats(3)
+        with pytest.raises(ValueError):
+            s.update(np.zeros((4, 5)))
+        s.update(np.zeros((1, 3)))
+        with pytest.raises(ValueError):
+            s.finalize()  # n < 2
+
+
+class TestFrechetDistance:
+    def test_identical_gaussians_zero(self):
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(200, 6))
+        cov = np.cov(a, rowvar=False)
+        mu = a.mean(axis=0)
+        assert frechet_distance(mu, cov, mu, cov) < 1e-8
+
+    def test_univariate_closed_form(self):
+        # FID(N(m1,s1^2), N(m2,s2^2)) = (m1-m2)^2 + s1^2 + s2^2 - 2 s1 s2
+        m1, s1, m2, s2 = 0.0, 1.0, 3.0, 2.0
+        got = frechet_distance([m1], [[s1 ** 2]], [m2], [[s2 ** 2]])
+        want = (m1 - m2) ** 2 + s1 ** 2 + s2 ** 2 - 2 * s1 * s2
+        assert abs(got - want) < 1e-10
+
+    def test_diagonal_closed_form(self):
+        d1 = np.array([1.0, 4.0])
+        d2 = np.array([9.0, 1.0])
+        mu1, mu2 = np.zeros(2), np.array([1.0, -1.0])
+        want = (np.sum((mu1 - mu2) ** 2)
+                + np.sum(d1 + d2 - 2 * np.sqrt(d1 * d2)))
+        got = frechet_distance(mu1, np.diag(d1), mu2, np.diag(d2))
+        assert abs(got - want) < 1e-10
+
+    def test_separated_means_dominate(self):
+        cov = np.eye(3)
+        near = frechet_distance(np.zeros(3), cov, 0.1 * np.ones(3), cov)
+        far = frechet_distance(np.zeros(3), cov, 5.0 * np.ones(3), cov)
+        assert far > near > 0
+
+
+class TestFeatureExtractors:
+    def test_deterministic_across_builds(self):
+        f1, d1 = make_random_feature_fn(32, 3, feature_dim=64)
+        f2, d2 = make_random_feature_fn(32, 3, feature_dim=64)
+        x = jnp.asarray(np.random.default_rng(0).uniform(
+            -1, 1, size=(4, 32, 32, 3)).astype(np.float32))
+        assert d1 == d2 == 64
+        np.testing.assert_array_equal(np.asarray(f1(x)), np.asarray(f2(x)))
+
+    def test_seed_changes_features(self):
+        f1, _ = make_random_feature_fn(16, 3, feature_dim=32, seed=1)
+        f2, _ = make_random_feature_fn(16, 3, feature_dim=32, seed=2)
+        x = jnp.ones((2, 16, 16, 3))
+        assert not np.allclose(np.asarray(f1(x)), np.asarray(f2(x)))
+
+    def test_npz_roundtrip(self, tmp_path):
+        # export a tiny embedder and reload it through the npz slot
+        key = jax.random.key(0)
+        from dcgan_tpu.ops.layers import conv2d_init
+
+        conv = conv2d_init(key, 3, 8)
+        proj = np.random.default_rng(0).normal(size=(8, 16)).astype(np.float32)
+        path = str(tmp_path / "emb.npz")
+        np.savez(path, **{"conv0/w": np.asarray(conv["w"]),
+                          "conv0/b": np.asarray(conv["b"]), "proj": proj})
+        fn, dim = make_npz_feature_fn(path)
+        assert dim == 16
+        out = fn(jnp.ones((2, 16, 16, 3)))
+        assert out.shape == (2, 16) and np.isfinite(np.asarray(out)).all()
+
+    def test_npz_missing_keys_rejected(self, tmp_path):
+        path = str(tmp_path / "bad.npz")
+        np.savez(path, foo=np.zeros(3))
+        with pytest.raises(ValueError):
+            make_npz_feature_fn(path)
+
+
+def _image_stream(seed, n_per_batch, size, shift=0.0):
+    rng = np.random.default_rng(seed)
+    while True:
+        yield np.clip(rng.normal(loc=shift, scale=0.3,
+                                 size=(n_per_batch, size, size, 3)),
+                      -1, 1).astype(np.float32)
+
+
+class TestEvalJob:
+    def test_stats_from_batches_exact_count(self):
+        fn, dim = make_random_feature_fn(16, 3, feature_dim=32)
+        stats = stats_from_batches(fn, _image_stream(0, 24, 16), 100, dim)
+        assert stats.n == 100  # trimmed mid-batch
+
+    def test_stats_exhaustion_raises(self):
+        fn, dim = make_random_feature_fn(16, 3, feature_dim=32)
+        finite = [next(_image_stream(0, 8, 16)) for _ in range(2)]
+        with pytest.raises(ValueError):
+            stats_from_batches(fn, iter(finite), 100, dim)
+
+    def test_same_distribution_scores_near_zero_vs_shifted(self):
+        fn, dim = make_random_feature_fn(16, 3, feature_dim=32)
+        a = stats_from_batches(fn, _image_stream(1, 64, 16), 512, dim)
+        b = stats_from_batches(fn, _image_stream(2, 64, 16), 512, dim)
+        c = stats_from_batches(fn, _image_stream(3, 64, 16, shift=0.8),
+                               512, dim)
+        same = frechet_distance(*a.finalize(), *b.finalize())
+        diff = frechet_distance(*a.finalize(), *c.finalize())
+        assert diff > 10 * same
+
+    def test_compute_fid_end_to_end(self):
+        """Untrained G vs gaussian 'reals': runs, finite, positive; and the
+        generator scored against its own samples is near zero."""
+        from dcgan_tpu.config import ModelConfig
+        from dcgan_tpu.models import gan_init, sampler_apply
+
+        mcfg = ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                           compute_dtype="float32")
+        params, bn = gan_init(jax.random.key(0), mcfg)
+
+        def sample_fn(z):
+            return sampler_apply(params["gen"], bn["gen"], z, cfg=mcfg)
+
+        result = compute_fid(sample_fn, _image_stream(0, 64, 16),
+                             image_size=16, z_dim=mcfg.z_dim,
+                             num_samples=256, batch_size=64)
+        assert result["num_samples"] == 256
+        assert np.isfinite(result["fid"]) and result["fid"] > 0
+
+        fn, dim = make_random_feature_fn(16, 3)
+        g1 = generator_stats(sample_fn, fn, dim, num_samples=256,
+                             batch_size=64, z_dim=mcfg.z_dim, seed=5)
+        g2 = generator_stats(sample_fn, fn, dim, num_samples=256,
+                             batch_size=64, z_dim=mcfg.z_dim, seed=6)
+        self_fid = frechet_distance(*g1.finalize(), *g2.finalize())
+        assert self_fid < result["fid"]
+
+    def test_conditional_generator_stats(self):
+        from dcgan_tpu.config import ModelConfig
+        from dcgan_tpu.models import gan_init, sampler_apply
+
+        mcfg = ModelConfig(output_size=16, gf_dim=8, df_dim=8, num_classes=4,
+                           compute_dtype="float32")
+        params, bn = gan_init(jax.random.key(0), mcfg)
+
+        def sample_fn(z, labels):
+            return sampler_apply(params["gen"], bn["gen"], z, cfg=mcfg,
+                                 labels=labels)
+
+        fn, dim = make_random_feature_fn(16, 3, feature_dim=32)
+        stats = generator_stats(sample_fn, fn, dim, num_samples=96,
+                                batch_size=32, z_dim=mcfg.z_dim,
+                                num_classes=4)
+        assert stats.n == 96
