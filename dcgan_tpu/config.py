@@ -38,6 +38,9 @@ class ModelConfig:
     kernel_size: int = 5           # conv / deconv kernel (distriubted_model.py:176,190)
     compute_dtype: str = "bfloat16"  # MXU-native compute precision
     param_dtype: str = "float32"     # parameter / BN-stat storage precision
+    use_pallas: bool = False       # fused Pallas BN+activation kernels
+                                   # (ops/pallas_kernels.py; single-chip /
+                                   # per-shard execution)
 
     def __post_init__(self):
         n = self.num_up_layers

@@ -112,18 +112,19 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
     top_ch = cfg.gf_dim * (2 ** (k - 1))
     h = linear_apply(params["proj"], z.astype(cdt), compute_dtype=cdt)
     h = h.reshape(-1, cfg.base_size, cfg.base_size, top_ch)
+    # BN + relu fused (one pass under use_pallas; XLA-fused otherwise)
     h, new_state["bn0"] = batch_norm_apply(
         params["bn0"], state["bn0"], h, train=train,
-        momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name)
-    h = jax.nn.relu(h)
+        momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name,
+        act="relu", use_pallas=cfg.use_pallas)
 
     for i in range(1, k + 1):
         h = deconv2d_apply(params[f"deconv{i}"], h, compute_dtype=cdt)
         if i < k:
             h, new_state[f"bn{i}"] = batch_norm_apply(
                 params[f"bn{i}"], state[f"bn{i}"], h, train=train,
-                momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name)
-            h = jax.nn.relu(h)
+                momentum=cfg.bn_momentum, eps=cfg.bn_eps,
+                axis_name=axis_name, act="relu", use_pallas=cfg.use_pallas)
 
     return jnp.tanh(h.astype(jnp.float32)), new_state
 
@@ -189,10 +190,14 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
     for i in range(k):
         h = conv2d_apply(params[f"conv{i}"], h, compute_dtype=cdt)
         if i > 0:
+            # BN + lrelu fused (stage 0 keeps the reference's no-BN shape)
             h, new_state[f"bn{i}"] = batch_norm_apply(
                 params[f"bn{i}"], state[f"bn{i}"], h, train=train,
-                momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name)
-        h = lrelu(h, cfg.leak)
+                momentum=cfg.bn_momentum, eps=cfg.bn_eps,
+                axis_name=axis_name, act="lrelu", leak=cfg.leak,
+                use_pallas=cfg.use_pallas)
+        else:
+            h = lrelu(h, cfg.leak)
 
     h = h.reshape(h.shape[0], -1)
     logit = linear_apply(params["head"], h, compute_dtype=cdt)

@@ -49,27 +49,37 @@ def batch_norm_init(key, num_features: int, *, dtype=jnp.float32,
 
 def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
                      train: bool, momentum: float = 0.9, eps: float = 1e-5,
-                     axis_name: Optional[str] = None
+                     axis_name: Optional[str] = None, act: str = "none",
+                     leak: float = 0.2, use_pallas: bool = False
                      ) -> Tuple[jax.Array, Pytree]:
-    """Normalize `x` over all axes but the last (channel) axis.
+    """Normalize `x` over all axes but the last (channel) axis, optionally
+    fusing the following activation (`act` in {"none","relu","lrelu","tanh"}).
 
     train=True : use batch moments, return EMA-updated state
                  (the reference's moments over [0,1,2] with a [0,1] fallback for
                  2-D inputs, distriubted_model.py:36-39, generalizes to "all but
                  channels" here).
     train=False: use the running statistics; state is returned unchanged.
-    """
-    reduce_axes = tuple(range(x.ndim - 1))
-    scale = params["scale"].astype(x.dtype)
-    bias = params["bias"].astype(x.dtype)
 
+    use_pallas=True routes the moments reduction and the normalize+activation
+    epilogue through the fused Pallas kernels (ops/pallas_kernels.py) — one
+    HBM pass each way instead of one per op.
+    """
     if train:
-        # Moments in float32 even under bfloat16 activations — bf16 accumulation
-        # over a 64*64*64 reduction loses too many bits for stable statistics.
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=reduce_axes)
-        # E[x^2] - E[x]^2 so a single fused pass feeds both moments; psum-friendly.
-        mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+        if use_pallas:
+            from dcgan_tpu.ops.pallas_kernels import channel_moments
+
+            mean, mean_sq = channel_moments(x.reshape(-1, x.shape[-1]))
+        else:
+            # Moments in float32 even under bfloat16 activations — bf16
+            # accumulation over a 64*64*64 reduction loses too many bits for
+            # stable statistics.
+            reduce_axes = tuple(range(x.ndim - 1))
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            # E[x^2] - E[x]^2 so a single fused pass feeds both moments;
+            # psum-friendly.
+            mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
         if axis_name is not None:
             mean = lax.pmean(mean, axis_name)
             mean_sq = lax.pmean(mean_sq, axis_name)
@@ -84,10 +94,30 @@ def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
                    + (1.0 - momentum) * var.astype(stat_dtype),
         }
     else:
-        mean = state["mean"].astype(x.dtype)
-        var = state["var"].astype(x.dtype)
+        mean = state["mean"]
+        var = state["var"]
         new_state = state
 
+    if use_pallas:
+        from dcgan_tpu.ops.pallas_kernels import fused_bn_act
+
+        y = fused_bn_act(x, params["scale"], params["bias"], mean, var,
+                         eps=eps, act=act, leak=leak)
+        return y, new_state
+
+    scale = params["scale"].astype(x.dtype)
+    bias = params["bias"].astype(x.dtype)
     inv = lax.rsqrt(var.astype(x.dtype) + jnp.asarray(eps, x.dtype))
     y = (x - mean.astype(x.dtype)) * inv * scale + bias
+    y = _apply_act(y, act, leak)
     return y, new_state
+
+
+def _apply_act(y: jax.Array, act: str, leak: float) -> jax.Array:
+    # single dispatch table shared with the pallas kernels so the two BN
+    # paths cannot silently diverge
+    from dcgan_tpu.ops.pallas_kernels import ACTS, _act_fwd
+
+    if act not in ACTS:
+        raise ValueError(f"unknown act {act!r}")
+    return _act_fwd(y, act, leak)

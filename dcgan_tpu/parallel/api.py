@@ -48,6 +48,14 @@ class ParallelTrain:
 def make_parallel_train(cfg: TrainConfig,
                         mesh: Optional[Mesh] = None) -> ParallelTrain:
     mesh = mesh or make_mesh(cfg.mesh)
+    if cfg.model.use_pallas and mesh.size > 1:
+        # pallas_call is opaque to GSPMD: under a sharded mesh XLA would
+        # replicate activations around every BN instead of partitioning —
+        # silent collapse of data parallelism. Reject rather than degrade.
+        raise ValueError(
+            f"use_pallas requires a single-device mesh, got {mesh.size} "
+            "devices; the fused kernels target single-chip / per-shard "
+            "execution (ops/pallas_kernels.py)")
     fns = make_train_step(cfg)
 
     state_shapes = jax.eval_shape(fns.init, jax.random.key(0))

@@ -42,6 +42,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--df_dim", type=int, default=64)
     p.add_argument("--num_classes", type=int, default=0,
                    help=">0 = class-conditional G/D")
+    p.add_argument("--use_pallas", action="store_true",
+                   help="fused Pallas BN+activation kernels (single-chip)")
     # data (image_train.py:19-26)
     p.add_argument("--dataset", default="celebA")
     p.add_argument("--data_dir", default="train")
@@ -84,7 +86,7 @@ def config_from_args(args: argparse.Namespace) -> TrainConfig:
         model=ModelConfig(
             output_size=args.output_size, c_dim=args.c_dim,
             z_dim=args.z_dim, gf_dim=args.gf_dim, df_dim=args.df_dim,
-            num_classes=args.num_classes),
+            num_classes=args.num_classes, use_pallas=args.use_pallas),
         mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
         learning_rate=args.learning_rate, beta1=args.beta1,
         batch_size=args.batch_size, max_steps=args.max_steps,
