@@ -1,0 +1,38 @@
+"""Activation dispatch shared by the plain-jnp and Pallas BN paths.
+
+One table for forward and derivative so the two implementations of the fused
+BN epilogue (ops/norm.py jnp path, ops/pallas_kernels.py kernels) cannot
+silently diverge — and so the default path never imports
+jax.experimental.pallas. Covers the reference's activation set: relu
+(generator, distriubted_model.py:95-106), lrelu(0.2) (discriminator,
+distriubted_model.py:118-121,156), tanh (generator output, :111).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+ACTS = ("none", "relu", "lrelu", "tanh")
+LEAK = 0.2  # lrelu slope (distriubted_model.py:156)
+
+
+def act_fwd(u: jax.Array, act: str, leak: float = LEAK) -> jax.Array:
+    if act == "relu":
+        return jnp.maximum(u, 0.0)
+    if act == "lrelu":
+        return jnp.maximum(u, leak * u)
+    if act == "tanh":
+        return jnp.tanh(u)
+    return u
+
+
+def act_grad(u: jax.Array, act: str, leak: float = LEAK) -> jax.Array:
+    if act == "relu":
+        return jnp.where(u > 0.0, 1.0, 0.0)
+    if act == "lrelu":
+        return jnp.where(u > 0.0, 1.0, leak)
+    if act == "tanh":
+        t = jnp.tanh(u)
+        return 1.0 - t * t
+    return jnp.ones_like(u)

@@ -114,10 +114,11 @@ def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
 
 
 def _apply_act(y: jax.Array, act: str, leak: float) -> jax.Array:
-    # single dispatch table shared with the pallas kernels so the two BN
-    # paths cannot silently diverge
-    from dcgan_tpu.ops.pallas_kernels import ACTS, _act_fwd
+    # dispatch table shared with the pallas kernels (ops/activations.py) so
+    # the two BN paths cannot silently diverge — without pulling
+    # jax.experimental.pallas into the default path
+    from dcgan_tpu.ops.activations import ACTS, act_fwd
 
     if act not in ACTS:
         raise ValueError(f"unknown act {act!r}")
-    return _act_fwd(y, act, leak)
+    return act_fwd(y, act, leak)

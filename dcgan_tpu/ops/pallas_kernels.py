@@ -35,8 +35,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-ACTS = ("none", "relu", "lrelu", "tanh")
-LEAK = 0.2  # lrelu slope (distriubted_model.py:156)
+from dcgan_tpu.ops.activations import ACTS, LEAK
+from dcgan_tpu.ops.activations import act_fwd as _act_fwd
+from dcgan_tpu.ops.activations import act_grad as _act_grad
 
 
 def _interpret() -> bool:
@@ -50,27 +51,6 @@ def _row_tile(n: int) -> int:
     while n % tile:
         tile -= 1
     return tile
-
-
-def _act_fwd(u: jax.Array, act: str, leak: float = LEAK) -> jax.Array:
-    if act == "relu":
-        return jnp.maximum(u, 0.0)
-    if act == "lrelu":
-        return jnp.maximum(u, leak * u)
-    if act == "tanh":
-        return jnp.tanh(u)
-    return u
-
-
-def _act_grad(u: jax.Array, act: str, leak: float = LEAK) -> jax.Array:
-    if act == "relu":
-        return jnp.where(u > 0.0, 1.0, 0.0)
-    if act == "lrelu":
-        return jnp.where(u > 0.0, 1.0, leak)
-    if act == "tanh":
-        t = jnp.tanh(u)
-        return 1.0 - t * t
-    return jnp.ones_like(u)
 
 
 # ---------------------------------------------------------------------------
@@ -157,6 +137,11 @@ def _ssa_bwd_kernel(x_ref, scale_ref, shift_ref, g_ref,
 
 
 def _ssa_impl(x2d, scale, shift, act, leak):
+    # Validated here — shared by the primal and the custom-VJP forward — so a
+    # bad act name errors under jax.grad too (the primal wrapper is bypassed
+    # when differentiating) instead of silently applying identity.
+    if act not in ACTS:
+        raise ValueError(f"unknown act {act!r}")
     n, c = x2d.shape
     tile = _row_tile(n)
     vec_spec = pl.BlockSpec((1, c), lambda i: (0, 0))
@@ -177,8 +162,6 @@ def scale_shift_act(x2d: jax.Array, scale: jax.Array, shift: jax.Array,
                     act: str = "none", leak: float = LEAK) -> jax.Array:
     """Fused y = act(x * scale + shift) over [N, C] with per-channel [C]
     scale/shift. act in {"none", "relu", "lrelu", "tanh"}."""
-    if act not in ACTS:
-        raise ValueError(f"unknown act {act!r}")
     return _ssa_impl(x2d, scale, shift, act, leak)
 
 

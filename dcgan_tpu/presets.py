@@ -1,0 +1,94 @@
+"""Named training presets — the BASELINE.json config matrix as one-call configs.
+
+BASELINE.json lists five benchmark configurations for this framework; each is a
+`TrainConfig` factory here so `python -m dcgan_tpu.train --preset <name>` (and
+tests/bench code) can materialize them without repeating knob soup:
+
+- ``celeba64``    — DCGAN 64x64 CelebA, single-host, z=100, batch 64: the
+  reference's headline workload (image_train.py:42-48, distriubted_model.py:7-12).
+- ``lsun64-dp8``  — DCGAN 64x64 LSUN-bedroom, data-parallel over 8 chips
+  (v5e-8): global batch 64*8 sharded over the "data" mesh axis, grads psum'd
+  over ICI — the sync replacement for the reference's async PS workers
+  (SURVEY.md §2.5).
+- ``dcgan128``    — 128x128: one extra stride-2 stage in both stacks
+  (ModelConfig.num_up_layers == 5) with cross-replica synced BatchNorm.
+- ``cifar10-cond`` — class-conditional DCGAN on CIFAR-10 (32x32, 10 classes):
+  activates the reference's accepted-but-ignored `y` argument
+  (distriubted_model.py:83, SURVEY.md §2.4 #7).
+- ``wgan-gp``     — WGAN-GP loss variant: Wasserstein critic + gradient
+  penalty (grad-of-grad), canonical lr 1e-4 / β1 0 hyperparameters.
+
+Every preset factory takes overrides as keyword arguments forwarded to
+`dataclasses.replace`-style reconstruction, so the CLI's explicit flags win
+over preset defaults.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict
+
+from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+
+
+def _build(model: ModelConfig, mesh: MeshConfig, **train_kw) -> TrainConfig:
+    return TrainConfig(model=model, mesh=mesh, **train_kw)
+
+
+def celeba64(**overrides) -> TrainConfig:
+    """DCGAN 64x64 CelebA, single-host (the reference's headline workload)."""
+    cfg = _build(ModelConfig(output_size=64), MeshConfig(),
+                 batch_size=64, dataset="celebA")
+    return dataclasses.replace(cfg, **overrides)
+
+
+def lsun64_dp8(**overrides) -> TrainConfig:
+    """DCGAN 64x64 LSUN-bedroom, data-parallel over an 8-chip mesh."""
+    cfg = _build(ModelConfig(output_size=64), MeshConfig(data=8),
+                 batch_size=64 * 8, dataset="lsun-bedroom")
+    return dataclasses.replace(cfg, **overrides)
+
+
+def dcgan128(**overrides) -> TrainConfig:
+    """DCGAN 128x128: deeper G/D (5 up/down stages), synced BN across mesh."""
+    cfg = _build(ModelConfig(output_size=128), MeshConfig(),
+                 batch_size=64)
+    return dataclasses.replace(cfg, **overrides)
+
+
+def cifar10_cond(**overrides) -> TrainConfig:
+    """Class-conditional DCGAN on CIFAR-10 (32x32 RGB, 10 classes)."""
+    cfg = _build(ModelConfig(output_size=32, num_classes=10),
+                 MeshConfig(), batch_size=64, dataset="cifar10")
+    return dataclasses.replace(cfg, **overrides)
+
+
+def wgan_gp(**overrides) -> TrainConfig:
+    """WGAN-GP on 64x64: critic + gradient penalty, lr 1e-4, β1=0.
+
+    The BCE defaults (lr 2e-4, β1 0.5, image_train.py:11-13) destabilize a
+    Wasserstein critic; these are the standard WGAN-GP settings (Gulrajani et
+    al. 2017) and apply only when the flags are left at their defaults.
+    """
+    cfg = _build(ModelConfig(output_size=64), MeshConfig(),
+                 batch_size=64, loss="wgan-gp",
+                 learning_rate=1e-4, beta1=0.0)
+    return dataclasses.replace(cfg, **overrides)
+
+
+PRESETS: Dict[str, Callable[..., TrainConfig]] = {
+    "celeba64": celeba64,
+    "lsun64-dp8": lsun64_dp8,
+    "dcgan128": dcgan128,
+    "cifar10-cond": cifar10_cond,
+    "wgan-gp": wgan_gp,
+}
+
+
+def get_preset(name: str, **overrides) -> TrainConfig:
+    try:
+        factory = PRESETS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown preset {name!r}; available: {sorted(PRESETS)}") from None
+    return factory(**overrides)

@@ -18,13 +18,17 @@ import dataclasses
 import pprint
 from typing import List, Optional
 
-from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from dcgan_tpu.config import TrainConfig
 
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dcgan_tpu.train",
         description="TPU-native distributed DCGAN trainer")
+    from dcgan_tpu.presets import PRESETS
+    p.add_argument("--preset", choices=sorted(PRESETS), default=None,
+                   help="named BASELINE.json config (presets.py); explicit "
+                        "flags override preset defaults")
     # optimization (reference defaults: image_train.py:11-14)
     p.add_argument("--learning_rate", type=float, default=2e-4)
     p.add_argument("--beta1", type=float, default=0.5)
@@ -81,34 +85,74 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+# flag name -> (config section, field); sections: "model", "mesh", "" (top).
+_FLAG_FIELDS = {
+    "learning_rate": ("", "learning_rate"), "beta1": ("", "beta1"),
+    "batch_size": ("", "batch_size"), "max_steps": ("", "max_steps"),
+    "loss": ("", "loss"), "update_mode": ("", "update_mode"),
+    "dataset": ("", "dataset"), "data_dir": ("", "data_dir"),
+    "sample_image_dir": ("", "sample_image_dir"),
+    "record_dtype": ("", "record_dtype"),
+    "checkpoint_dir": ("", "checkpoint_dir"), "sample_dir": ("", "sample_dir"),
+    "save_summaries_secs": ("", "save_summaries_secs"),
+    "save_model_secs": ("", "save_model_secs"),
+    "sample_every_steps": ("", "sample_every_steps"),
+    "profile_dir": ("", "profile_dir"),
+    "profile_start_step": ("", "profile_start_step"),
+    "profile_num_steps": ("", "profile_num_steps"),
+    "timing_window": ("", "timing_window"), "seed": ("", "seed"),
+    "output_size": ("model", "output_size"), "c_dim": ("model", "c_dim"),
+    "z_dim": ("model", "z_dim"), "gf_dim": ("model", "gf_dim"),
+    "df_dim": ("model", "df_dim"), "num_classes": ("model", "num_classes"),
+    "use_pallas": ("model", "use_pallas"),
+    "mesh_data": ("mesh", "data"), "mesh_model": ("mesh", "model"),
+}
+
+
+def explicit_flags(argv: Optional[List[str]]) -> argparse.Namespace:
+    """Namespace containing ONLY the flags the user actually passed.
+
+    A second parse with every default suppressed — so preset defaults and
+    explicit overrides can be told apart.
+    """
+    p = build_parser()
+    for action in p._actions:
+        if action.dest != "help":
+            action.default = argparse.SUPPRESS
+    return p.parse_args(argv)
+
+
+def apply_overrides(cfg: TrainConfig, given: argparse.Namespace) -> TrainConfig:
+    """Apply explicitly-passed flags on top of a preset TrainConfig."""
+    top, model_kw, mesh_kw = {}, {}, {}
+    for flag, value in vars(given).items():
+        if flag == "no_normalize":
+            top["normalize_inputs"] = not value
+            continue
+        if flag not in _FLAG_FIELDS:
+            continue  # preset / synthetic / platform — not config fields
+        section, field = _FLAG_FIELDS[flag]
+        {"": top, "model": model_kw, "mesh": mesh_kw}[section][field] = value
+    if model_kw:
+        top["model"] = dataclasses.replace(cfg.model, **model_kw)
+    if mesh_kw:
+        top["mesh"] = dataclasses.replace(cfg.mesh, **mesh_kw)
+    return dataclasses.replace(cfg, **top) if top else cfg
+
+
 def config_from_args(args: argparse.Namespace) -> TrainConfig:
-    return TrainConfig(
-        model=ModelConfig(
-            output_size=args.output_size, c_dim=args.c_dim,
-            z_dim=args.z_dim, gf_dim=args.gf_dim, df_dim=args.df_dim,
-            num_classes=args.num_classes, use_pallas=args.use_pallas),
-        mesh=MeshConfig(data=args.mesh_data, model=args.mesh_model),
-        learning_rate=args.learning_rate, beta1=args.beta1,
-        batch_size=args.batch_size, max_steps=args.max_steps,
-        loss=args.loss, update_mode=args.update_mode,
-        dataset=args.dataset, data_dir=args.data_dir,
-        sample_image_dir=args.sample_image_dir,
-        record_dtype=args.record_dtype,
-        normalize_inputs=not args.no_normalize,
-        checkpoint_dir=args.checkpoint_dir, sample_dir=args.sample_dir,
-        save_summaries_secs=args.save_summaries_secs,
-        save_model_secs=args.save_model_secs,
-        sample_every_steps=args.sample_every_steps,
-        profile_dir=args.profile_dir,
-        profile_start_step=args.profile_start_step,
-        profile_num_steps=args.profile_num_steps,
-        timing_window=args.timing_window,
-        seed=args.seed)
+    # Same mapping as the preset-override path (a fully-populated namespace
+    # over the defaults) so there is exactly one flag->field table.
+    return apply_overrides(TrainConfig(), args)
 
 
 def main(argv: Optional[List[str]] = None) -> None:
     args = build_parser().parse_args(argv)
-    cfg = config_from_args(args)
+    if args.preset:
+        from dcgan_tpu.presets import get_preset
+        cfg = apply_overrides(get_preset(args.preset), explicit_flags(argv))
+    else:
+        cfg = config_from_args(args)
     # echo the effective config at startup, like the reference's
     # pp.pprint(FLAGS.__flags) (image_train.py:223)
     pprint.pprint(dataclasses.asdict(cfg))

@@ -107,6 +107,16 @@ class TestScaleShiftAct:
             scale_shift_act(jnp.ones((4, 4)), jnp.ones(4), jnp.zeros(4),
                             "gelu")
 
+    def test_bad_act_rejected_under_grad(self):
+        # Differentiation bypasses the primal wrapper (custom_vjp routes
+        # through the vjp-fwd rule), so validation must live in the shared
+        # impl or a typo'd act silently becomes identity.
+        def loss(x):
+            return jnp.sum(scale_shift_act(x, jnp.ones(4), jnp.zeros(4),
+                                           "gelu"))
+        with pytest.raises(ValueError):
+            jax.grad(loss)(jnp.ones((4, 4)))
+
     def test_under_jit(self):
         x = _rand(10, (32, 8))
         f = jax.jit(lambda x: scale_shift_act(x, jnp.ones(8), jnp.zeros(8),
