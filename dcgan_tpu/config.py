@@ -117,6 +117,9 @@ class TrainConfig:
     normalize_inputs: bool = True  # map reals to [-1,1]; the reference never does
                                    # (SURVEY.md §2.4 #1) — set False for strict parity
     record_dtype: str = "float64"  # on-disk pixel dtype (image_input.py:48)
+    label_feature: str = "label"   # int64 per-example class feature, read when
+                                   # model.num_classes > 0 (the schema the
+                                   # reference comments out, image_input.py:44)
 
     # Observability (image_train.py:37,129,179)
     checkpoint_dir: str = "checkpoint"

@@ -7,7 +7,11 @@ with a C++ reader/shuffler/batcher feeding sharded jax.Arrays directly, with
 prefetch so the TPU never waits on the host.
 """
 
-from dcgan_tpu.data.pipeline import DataConfig, make_dataset  # noqa: F401
+from dcgan_tpu.data.pipeline import (  # noqa: F401
+    DataConfig,
+    make_dataset,
+    to_global,
+)
 from dcgan_tpu.data.synthetic import (  # noqa: F401
     synthetic_batches,
     write_image_tfrecords,

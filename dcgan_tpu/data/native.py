@@ -13,7 +13,7 @@ import hashlib
 import os
 import subprocess
 import threading
-from typing import List, Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 
@@ -62,10 +62,11 @@ def _get_lib():
                 ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
                 ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                 ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
-                ctypes.c_int, ctypes.c_char_p]
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p]
             lib.dcgan_loader_next.restype = ctypes.c_int
             lib.dcgan_loader_next.argtypes = [ctypes.c_void_p,
-                                              ctypes.POINTER(ctypes.c_float)]
+                                              ctypes.POINTER(ctypes.c_float),
+                                              ctypes.POINTER(ctypes.c_int32)]
             lib.dcgan_loader_error.restype = ctypes.c_char_p
             lib.dcgan_loader_error.argtypes = [ctypes.c_void_p]
             lib.dcgan_loader_destroy.restype = None
@@ -85,7 +86,8 @@ class NativeLoader:
                  min_after_dequeue: int = 10_776, n_threads: int = 16,
                  prefetch_batches: int = 4, seed: int = 0,
                  normalize: bool = True, verify_crc: bool = True,
-                 loop: bool = True, feature_name: str = "image_raw"):
+                 loop: bool = True, feature_name: str = "image_raw",
+                 label_feature: str = ""):
         if record_dtype not in _DTYPE_CODES:
             raise ValueError(f"record_dtype must be one of {list(_DTYPE_CODES)}")
         for p in paths:
@@ -96,6 +98,7 @@ class NativeLoader:
         self._lib = _get_lib()
         self.batch = int(batch)
         self.example_shape = tuple(int(d) for d in example_shape)
+        self.labeled = bool(label_feature)
         n_floats = int(np.prod(self.example_shape))
         c_paths = (ctypes.c_char_p * len(paths))(
             *[p.encode() for p in paths])
@@ -104,18 +107,25 @@ class NativeLoader:
             _DTYPE_CODES[record_dtype], int(min_after_dequeue),
             int(n_threads), int(prefetch_batches), int(seed),
             int(bool(normalize)), int(bool(verify_crc)), int(bool(loop)),
-            feature_name.encode())
+            feature_name.encode(), label_feature.encode())
         if not self._handle:
             raise NativeLoaderError("loader_create failed")
         self._out = np.empty((self.batch,) + self.example_shape,
                              dtype=np.float32)
+        self._out_labels = (np.empty((self.batch,), dtype=np.int32)
+                            if self.labeled else None)
 
-    def next(self) -> Optional[np.ndarray]:
-        """Next [B, ...] float32 batch, or None at end-of-data (loop=False)."""
+    def next(self):
+        """Next float32 [B, ...] batch — or an ([B, ...], int32 [B]) pair for
+        labeled configs — or None at end-of-data (loop=False)."""
         rc = self._lib.dcgan_loader_next(
             self._handle,
-            self._out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            self._out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._out_labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            if self.labeled else None)
         if rc == 0:
+            if self.labeled:
+                return self._out.copy(), self._out_labels.copy()
             return self._out.copy()
         if rc == 1:
             return None

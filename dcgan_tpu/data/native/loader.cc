@@ -111,16 +111,19 @@ bool find_len_field(Slice msg, uint32_t field_num, Slice* out, size_t* resume) {
   return false;
 }
 
-// Example -> Features(1) -> map entries feature(1) {key(1), value(2)} ->
-// Feature.bytes_list(1).value(1). Returns the first bytes payload whose map
-// key equals `feature_name` (empty name = first bytes feature found).
-bool extract_bytes_feature(Slice example, const std::string& feature_name,
-                           Slice* out) {
-  Slice features;
-  if (!find_len_field(example, 1, &features, nullptr)) return false;
-  size_t resume = 0;
+// Example(1) -> the Features submessage holding the feature map. Parsed once
+// per record; both feature extractors below then scan this slice.
+bool get_features(Slice example, Slice* features) {
+  return find_len_field(example, 1, features, nullptr);
+}
+
+// Iterate Features' map entries feature(1) {key(1), value(2)}: each call
+// yields the next Feature value whose key equals `feature_name` (empty name
+// matches every entry). `resume` carries the scan position across calls.
+bool next_feature(Slice features, const std::string& feature_name, Slice* out,
+                  size_t* resume) {
   Slice entry;
-  while (find_len_field(features, 1, &entry, &resume)) {
+  while (find_len_field(features, 1, &entry, resume)) {
     Slice key{nullptr, 0}, value{nullptr, 0};
     find_len_field(entry, 1, &key, nullptr);
     if (!find_len_field(entry, 2, &value, nullptr)) continue;
@@ -128,12 +131,78 @@ bool extract_bytes_feature(Slice example, const std::string& feature_name,
         (key.n != feature_name.size() ||
          memcmp(key.p, feature_name.data(), key.n) != 0))
       continue;
-    Slice bytes_list;
-    if (!find_len_field(value, 1, &bytes_list, nullptr)) continue;  // oneof=1
-    Slice payload;
-    if (!find_len_field(bytes_list, 1, &payload, nullptr)) continue;
-    *out = payload;
+    *out = value;
     return true;
+  }
+  return false;
+}
+
+// Feature.bytes_list(1).value(1): first bytes payload of the named feature.
+// An empty name matches the first entry that *has* a bytes_list (entries of
+// other types — e.g. an int64 label preceding the image in map order — are
+// skipped, not errors).
+bool extract_bytes_feature(Slice features, const std::string& feature_name,
+                           Slice* out) {
+  size_t resume = 0;
+  Slice value;
+  while (next_feature(features, feature_name, &value, &resume)) {
+    Slice bytes_list;
+    if (!find_len_field(value, 1, &bytes_list, nullptr)) {  // oneof=1
+      if (feature_name.empty()) continue;  // wrong-typed entry; keep looking
+      return false;
+    }
+    if (find_len_field(bytes_list, 1, out, nullptr)) return true;
+    if (!feature_name.empty()) return false;
+  }
+  return false;
+}
+
+// Feature.int64_list(3).value(1): first int64 of the named feature. The
+// value field may be packed (wire type 2, TF's writer) or plain varints.
+bool extract_int64_feature(Slice features, const std::string& feature_name,
+                           int64_t* out) {
+  size_t fresume = 0;
+  Slice value;
+  if (!next_feature(features, feature_name, &value, &fresume)) return false;
+  Slice int64_list;
+  if (!find_len_field(value, 3, &int64_list, nullptr)) return false;  // oneof=3
+  size_t pos = 0;
+  while (pos < int64_list.n) {
+    uint64_t tag;
+    if (!read_varint(int64_list.p, int64_list.n, &pos, &tag)) return false;
+    uint32_t field = uint32_t(tag >> 3), wt = uint32_t(tag & 7);
+    if (field == 1 && wt == 0) {
+      uint64_t v;
+      if (!read_varint(int64_list.p, int64_list.n, &pos, &v)) return false;
+      *out = int64_t(v);
+      return true;
+    }
+    if (field == 1 && wt == 2) {
+      uint64_t len;
+      if (!read_varint(int64_list.p, int64_list.n, &pos, &len) ||
+          pos + len > int64_list.n)
+        return false;
+      if (len == 0) { continue; }
+      size_t p2 = pos;
+      uint64_t v;
+      if (!read_varint(int64_list.p, pos + size_t(len), &p2, &v)) return false;
+      *out = int64_t(v);
+      return true;
+    }
+    if (wt == 0) {
+      uint64_t v;
+      if (!read_varint(int64_list.p, int64_list.n, &pos, &v)) return false;
+    } else if (wt == 2) {
+      uint64_t len;
+      if (!read_varint(int64_list.p, int64_list.n, &pos, &len)) return false;
+      pos += len;
+    } else if (wt == 1) {
+      pos += 8;
+    } else if (wt == 5) {
+      pos += 4;
+    } else {
+      return false;
+    }
   }
   return false;
 }
@@ -156,7 +225,15 @@ struct LoaderConfig {
   bool normalize = true;          // x/127.5 - 1
   bool verify_crc = true;
   std::string feature_name = "image_raw";
+  std::string label_feature;      // non-empty: also read an int64 label per
+                                  // example (the feature the reference's
+                                  // pipeline comments out, image_input.py:44)
   bool loop = true;               // endless epochs (queue-runner semantics)
+
+  bool labeled() const { return !label_feature.empty(); }
+  // pooled examples carry the label as one trailing float so the shuffle
+  // pool / batcher stay image-vs-labeled agnostic
+  size_t stride() const { return example_floats + (labeled() ? 1 : 0); }
 };
 
 class Loader {
@@ -182,7 +259,8 @@ class Loader {
   }
 
   // 0 = ok; 1 = end of data (non-loop mode); -1 = error (see error()).
-  int Next(float* out) {
+  // out_labels may be null for unlabeled configs.
+  int Next(float* out, int32_t* out_labels) {
     std::unique_lock<std::mutex> lk(mu_);
     batch_cv_.wait(lk, [&] {
       return !batches_.empty() || (done_ && pool_.size() < size_t(cfg_.batch))
@@ -195,7 +273,16 @@ class Loader {
     lk.unlock();
     space_cv_.notify_one();
     batch_cv_.notify_all();  // the batcher waits for prefetch space on this cv
-    memcpy(out, b.data(), b.size() * sizeof(float));
+    if (!cfg_.labeled()) {
+      memcpy(out, b.data(), b.size() * sizeof(float));
+      return 0;
+    }
+    const size_t ex_n = cfg_.example_floats, stride = cfg_.stride();
+    for (int i = 0; i < cfg_.batch; ++i) {
+      const float* src = b.data() + size_t(i) * stride;
+      memcpy(out + size_t(i) * ex_n, src, ex_n * sizeof(float));
+      if (out_labels) out_labels[i] = int32_t(src[ex_n]);
+    }
     return 0;
   }
 
@@ -213,7 +300,7 @@ class Loader {
 
   bool DecodeExample(Slice payload, std::vector<float>* out) {
     const size_t n = cfg_.example_floats;
-    out->resize(n);
+    out->resize(cfg_.stride());
     if (cfg_.dtype == DT_F64) {
       if (payload.n != n * 8) return false;
       const double* src = reinterpret_cast<const double*>(payload.p);
@@ -286,9 +373,14 @@ class Loader {
               return;
             }
           }
+          Slice features;
+          if (!get_features({buf.data(), size_t(len)}, &features)) {
+            Fail("malformed Example in " + cfg_.paths[fi]);
+            fclose(f);
+            return;
+          }
           Slice payload;
-          if (!extract_bytes_feature({buf.data(), size_t(len)},
-                                     cfg_.feature_name, &payload)) {
+          if (!extract_bytes_feature(features, cfg_.feature_name, &payload)) {
             Fail("record missing feature '" + cfg_.feature_name + "' in " +
                  cfg_.paths[fi]);
             fclose(f);
@@ -299,6 +391,24 @@ class Loader {
             Fail("bad example payload size in " + cfg_.paths[fi]);
             fclose(f);
             return;
+          }
+          if (cfg_.labeled()) {
+            int64_t label = 0;
+            if (!extract_int64_feature(features, cfg_.label_feature, &label)) {
+              Fail("record missing int64 feature '" + cfg_.label_feature +
+                   "' in " + cfg_.paths[fi]);
+              fclose(f);
+              return;
+            }
+            // labels ride a float32 pool slot; beyond 2^24 that representation
+            // is lossy, so reject rather than silently corrupt class ids
+            if (label < 0 || label > (int64_t(1) << 24)) {
+              Fail("label " + std::to_string(label) + " out of range [0, 2^24]"
+                   " in " + cfg_.paths[fi]);
+              fclose(f);
+              return;
+            }
+            ex[cfg_.example_floats] = float(label);
           }
           read_any = true;
           PushExample(std::move(ex));
@@ -327,7 +437,7 @@ class Loader {
   }
 
   void BatcherLoop() {
-    const size_t ex_n = cfg_.example_floats;
+    const size_t ex_n = cfg_.stride();
     while (true) {
       std::vector<std::vector<float>> picked;
       {
@@ -394,7 +504,8 @@ void* dcgan_loader_create(const char** paths, int n_paths, int batch,
                           int example_floats, int record_dtype,
                           int min_after_dequeue, int n_threads,
                           int prefetch_batches, uint64_t seed, int normalize,
-                          int verify_crc, int loop, const char* feature_name) {
+                          int verify_crc, int loop, const char* feature_name,
+                          const char* label_feature) {
   LoaderConfig cfg;
   for (int i = 0; i < n_paths; ++i) cfg.paths.emplace_back(paths[i]);
   cfg.batch = batch;
@@ -408,11 +519,14 @@ void* dcgan_loader_create(const char** paths, int n_paths, int batch,
   cfg.verify_crc = verify_crc != 0;
   cfg.loop = loop != 0;
   if (feature_name) cfg.feature_name = feature_name;
+  if (label_feature) cfg.label_feature = label_feature;
   return new Loader(std::move(cfg));
 }
 
-int dcgan_loader_next(void* handle, float* out) {
-  return static_cast<Loader*>(handle)->Next(out);
+// out_labels: int32[batch] when the loader was created with a label_feature;
+// pass null for unlabeled configs.
+int dcgan_loader_next(void* handle, float* out, int32_t* out_labels) {
+  return static_cast<Loader*>(handle)->Next(out, out_labels);
 }
 
 const char* dcgan_loader_error(void* handle) {

@@ -42,6 +42,10 @@ class DataConfig:
     seed: int = 0
     normalize: bool = True          # [-1,1]; False = strict reference parity
     feature_name: str = "image_raw"
+    label_feature: str = ""         # non-empty: also read an int64 label per
+                                    # example (the feature the reference
+                                    # comments out, image_input.py:44) and
+                                    # yield (images, labels) batches
     use_native: bool = True         # C++ loader; False = pure-Python fallback
     loop: bool = True
 
@@ -80,9 +84,10 @@ class PythonLoader:
                  min_after_dequeue: int = 1024, n_threads: int = 4,
                  prefetch_batches: int = 4, seed: int = 0,
                  normalize: bool = True, loop: bool = True,
-                 feature_name: str = "image_raw"):
+                 feature_name: str = "image_raw", label_feature: str = ""):
         self.batch = batch
         self.example_shape = tuple(example_shape)
+        self.labeled = bool(label_feature)
         self._paths = list(paths)
         self._dtype = np.dtype(record_dtype)
         self._mad = min_after_dequeue
@@ -92,6 +97,7 @@ class PythonLoader:
         self._normalize = normalize
         self._loop = loop
         self._feature = feature_name
+        self._label_feature = label_feature
         self._rng = random.Random(seed)
         self._pool: List[np.ndarray] = []
         self._pool_lock = threading.Condition()
@@ -131,6 +137,19 @@ class PythonLoader:
                             raise ValueError(
                                 f"record missing feature {self._feature!r}")
                         x = self._decode(feats[self._feature][0])
+                        if self.labeled:
+                            lab = feats.get(self._label_feature)
+                            if not lab:
+                                raise ValueError(
+                                    "record missing int64 feature "
+                                    f"{self._label_feature!r}")
+                            # same bound as the native loader: reject rather
+                            # than silently wrap/round class ids
+                            if not 0 <= int(lab[0]) <= (1 << 24):
+                                raise ValueError(
+                                    f"label {int(lab[0])} out of range "
+                                    "[0, 2^24]")
+                            x = (x, np.int32(lab[0]))
                         read_any = True
                         with self._pool_lock:
                             self._pool_lock.wait_for(
@@ -172,9 +191,16 @@ class PythonLoader:
                                                      self._pool[j])
                     picked.append(self._pool.pop())
                 self._pool_lock.notify_all()  # wake readers waiting for space
-            self._batches.put(np.stack(picked))
+            if self.labeled:
+                self._batches.put((np.stack([p[0] for p in picked]),
+                                   np.asarray([p[1] for p in picked],
+                                              dtype=np.int32)))
+            else:
+                self._batches.put(np.stack(picked))
 
-    def next(self) -> Optional[np.ndarray]:
+    def next(self):
+        """Next [B, ...] batch — an (images, int32 labels) pair when labeled —
+        or None at end-of-data."""
         b = self._batches.get()
         if b is None and self._error:
             raise RuntimeError(self._error)
@@ -210,7 +236,8 @@ def _make_loader(cfg: DataConfig, paths: Sequence[str], seed: int):
                   n_threads=cfg.n_threads,
                   prefetch_batches=cfg.prefetch_batches, seed=seed,
                   normalize=cfg.normalize, loop=cfg.loop,
-                  feature_name=cfg.feature_name)
+                  feature_name=cfg.feature_name,
+                  label_feature=cfg.label_feature)
     if cfg.use_native:
         try:
             from dcgan_tpu.data.native import NativeLoader
@@ -222,32 +249,49 @@ def _make_loader(cfg: DataConfig, paths: Sequence[str], seed: int):
     return PythonLoader(paths, **kwargs)
 
 
-def make_dataset(cfg: DataConfig, sharding=None) -> Iterator:
+def to_global(batch, sharding, label_sharding=None):
+    """Host batch — or an (images, labels) pair — to global sharded arrays."""
+    import jax
+
+    if isinstance(batch, tuple):
+        imgs, labels = batch
+        if label_sharding is None:
+            raise ValueError("labeled dataset needs label_sharding")
+        return (jax.make_array_from_process_local_data(sharding, imgs),
+                jax.make_array_from_process_local_data(label_sharding, labels))
+    return jax.make_array_from_process_local_data(sharding, batch)
+
+
+def make_dataset(cfg: DataConfig, sharding=None,
+                 label_sharding=None) -> Iterator:
     """Endless (or one-epoch, cfg.loop=False) iterator of device batches.
 
     With `sharding` (a NamedSharding over the mesh's data axis), each yielded
     array is a global array assembled from this process's local batch —
     cfg.batch_size is the PER-PROCESS batch, and the global batch is
     batch_size * process_count. Without `sharding`, yields host numpy.
+
+    With cfg.label_feature set, yields (images, labels) pairs; labels use
+    `label_sharding` (required alongside `sharding` for labeled configs).
     """
     import jax
 
     paths = shard_for_process(list_shards(cfg.data_dir),
                               jax.process_index(), jax.process_count())
     loader = _make_loader(cfg, paths, cfg.seed + jax.process_index())
+    labeled = bool(cfg.label_feature)
 
     if sharding is None:
         yield from loader
         return
-
-    def put(batch: np.ndarray):
-        return jax.make_array_from_process_local_data(sharding, batch)
+    if labeled and label_sharding is None:
+        raise ValueError("labeled dataset needs label_sharding")
 
     # double-buffer: keep one device transfer in flight ahead of the consumer
     it = iter(loader)
     pending = None
     for batch in it:
-        nxt = put(batch)
+        nxt = to_global(batch, sharding, label_sharding)
         if pending is not None:
             yield pending
         pending = nxt

@@ -21,10 +21,14 @@ def write_image_tfrecords(out_dir: str, *, num_examples: int,
                           image_size: int = 64, channels: int = 3,
                           num_shards: int = 2, record_dtype: str = "float64",
                           seed: int = 0,
-                          feature_name: str = "image_raw") -> List[str]:
+                          feature_name: str = "image_raw",
+                          num_classes: int = 0,
+                          label_feature: str = "label") -> List[str]:
     """Write `num_examples` random images (pixel scale [0,255]) across shards.
 
-    Returns the shard paths.
+    num_classes > 0 also writes an int64 `label_feature` per example (the
+    schema the reference's pipeline comments out, image_input.py:44), for
+    conditional-model runs. Returns the shard paths.
     """
     rng = np.random.default_rng(seed)
     os.makedirs(out_dir, exist_ok=True)
@@ -41,7 +45,10 @@ def write_image_tfrecords(out_dir: str, *, num_examples: int,
                 img = rng.uniform(0, 255,
                                   size=(image_size, image_size, channels))
                 raw = img.astype(record_dtype).tobytes()
-                yield serialize_example({feature_name: [raw]})
+                feats = {feature_name: [raw]}
+                if num_classes:
+                    feats[label_feature] = [int(rng.integers(num_classes))]
+                yield serialize_example(feats)
 
         path = os.path.join(out_dir, f"shard-{s:05d}.tfrecord")
         write_tfrecords(path, records())
@@ -51,10 +58,18 @@ def write_image_tfrecords(out_dir: str, *, num_examples: int,
 
 
 def synthetic_batches(batch_size: int, image_size: int = 64, channels: int = 3,
-                      seed: int = 0) -> Iterator[np.ndarray]:
-    """Endless stream of [-1,1] float32 batches (no disk involved)."""
+                      seed: int = 0, num_classes: int = 0) -> Iterator:
+    """Endless stream of [-1,1] float32 batches (no disk involved).
+
+    num_classes > 0 yields (images, int32 labels) pairs instead.
+    """
     rng = np.random.default_rng(seed)
     while True:
-        yield np.tanh(rng.normal(
+        imgs = np.tanh(rng.normal(
             size=(batch_size, image_size, image_size, channels))
         ).astype(np.float32)
+        if num_classes:
+            yield imgs, rng.integers(num_classes, size=(batch_size,),
+                                     dtype=np.int32)
+        else:
+            yield imgs

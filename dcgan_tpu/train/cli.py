@@ -59,6 +59,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "SURVEY.md 2.4 #1)")
     p.add_argument("--record_dtype", default="float64",
                    choices=["float64", "float32", "uint8"])
+    p.add_argument("--label_feature", default="label",
+                   help="int64 class feature name in the records "
+                        "(used when --num_classes > 0)")
     # observability / checkpoint (image_train.py:20-21,37,129)
     p.add_argument("--checkpoint_dir", default="checkpoint")
     p.add_argument("--sample_dir", default="samples")
@@ -93,6 +96,7 @@ _FLAG_FIELDS = {
     "dataset": ("", "dataset"), "data_dir": ("", "data_dir"),
     "sample_image_dir": ("", "sample_image_dir"),
     "record_dtype": ("", "record_dtype"),
+    "label_feature": ("", "label_feature"),
     "checkpoint_dir": ("", "checkpoint_dir"), "sample_dir": ("", "sample_dir"),
     "save_summaries_secs": ("", "save_summaries_secs"),
     "save_model_secs": ("", "save_model_secs"),

@@ -30,7 +30,7 @@ import jax
 import numpy as np
 
 from dcgan_tpu.config import TrainConfig
-from dcgan_tpu.data import DataConfig, make_dataset, synthetic_batches
+from dcgan_tpu.data import DataConfig, make_dataset, synthetic_batches, to_global
 from dcgan_tpu.parallel import (
     batch_sharding,
     initialize_multihost,
@@ -47,14 +47,19 @@ Pytree = Any
 
 
 def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool) -> Iterator:
+    """Yields sharded image batches — (images, labels) pairs for conditional
+    models (cfg.model.num_classes > 0)."""
     sharding = batch_sharding(mesh, 4)
+    conditional = cfg.model.num_classes > 0
+    label_sharding = batch_sharding(mesh, 1) if conditional else None
     if synthetic:
         def it():
             per_proc = cfg.batch_size // jax.process_count()
             for batch in synthetic_batches(
                     per_proc, cfg.model.output_size, cfg.model.c_dim,
-                    seed=cfg.seed + jax.process_index()):
-                yield jax.make_array_from_process_local_data(sharding, batch)
+                    seed=cfg.seed + jax.process_index(),
+                    num_classes=cfg.model.num_classes):
+                yield to_global(batch, sharding, label_sharding)
         return it()
     dcfg = DataConfig(
         data_dir=cfg.data_dir,
@@ -65,8 +70,9 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool) -> Iterator:
         min_after_dequeue=cfg.shuffle_buffer,
         n_threads=cfg.num_loader_threads,
         seed=cfg.seed,
-        normalize=cfg.normalize_inputs)
-    return make_dataset(dcfg, sharding)
+        normalize=cfg.normalize_inputs,
+        label_feature=cfg.label_feature if conditional else "")
+    return make_dataset(dcfg, sharding, label_sharding)
 
 
 def train(cfg: TrainConfig, *, synthetic_data: bool = False,
@@ -108,16 +114,7 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
 
     data = _data_iterator(cfg, mesh, synthetic=synthetic_data)
     base_key = jax.random.key(cfg.seed + 2)
-    labels_iter = None
-    if cfg.model.num_classes:
-        # synthetic labels cycle; a real labeled dataset plugs in here
-        def labels_iter_fn():
-            per_proc = cfg.batch_size
-            i = 0
-            while True:
-                yield jax.numpy.arange(i, i + per_proc) % cfg.model.num_classes
-                i += 1
-        labels_iter = labels_iter_fn()
+    conditional = cfg.model.num_classes > 0
 
     total_steps = max_steps if max_steps is not None else cfg.max_steps
     start_step = int(jax.device_get(state["step"]))
@@ -134,12 +131,12 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
     # would force a per-step host sync and serialize the pipeline.
     for step_num in range(start_step, total_steps):
         trace.maybe_start(step_num)
-        images = next(data)
         key = jax.random.fold_in(base_key, step_num)
-        if labels_iter is not None:
-            state, metrics = pt.step(state, images, key, next(labels_iter))
+        if conditional:
+            images, labels = next(data)
+            state, metrics = pt.step(state, images, key, labels)
         else:
-            state, metrics = pt.step(state, images, key)
+            state, metrics = pt.step(state, next(data), key)
         new_step = step_num + 1
 
         if chief and cfg.log_every_steps and \

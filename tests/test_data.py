@@ -147,6 +147,81 @@ class TestLoaders:
         assert len(batches) == 2  # 40 examples -> 2 full batches of 16
         ld.close()
 
+    @pytest.mark.parametrize("loader_kind", ["native", "python"])
+    def test_labeled_batches(self, tmp_path, loader_kind):
+        """label_feature yields (images, int32 labels) pairs — the int64
+        feature the reference comments out (image_input.py:44)."""
+        paths = write_image_tfrecords(
+            str(tmp_path / "data"), num_examples=48, image_size=8,
+            channels=3, num_shards=3, num_classes=10)
+        kw = dict(LOADER_KW, label_feature="label")
+        if loader_kind == "native":
+            native = pytest.importorskip("dcgan_tpu.data.native")
+            ld = native.NativeLoader(paths, record_dtype="float64", **kw)
+        else:
+            ld = PythonLoader(paths, record_dtype="float64", **kw)
+        try:
+            for _ in range(3):
+                imgs, labels = ld.next()
+                assert imgs.shape == (16, 8, 8, 3)
+                assert imgs.dtype == np.float32
+                assert -1.0 <= imgs.min() and imgs.max() <= 1.0
+                assert labels.shape == (16,) and labels.dtype == np.int32
+                assert (0 <= labels).all() and (labels < 10).all()
+        finally:
+            ld.close()
+
+    def test_empty_feature_name_skips_non_bytes_entries(self, tmp_path):
+        """feature_name='' means 'first bytes feature' — an int64 entry that
+        happens to precede the image in map order must be skipped, not fail."""
+        native = pytest.importorskip("dcgan_tpu.data.native")
+        img = np.full((8, 8, 3), 128.0).astype("float64").tobytes()
+        path = str(tmp_path / "mixed.tfrecord")
+        # label entry serialized before the image entry
+        tfrecord.write_tfrecords(path, [serialize_example(
+            {"label": [3], "image_raw": [img]}) for _ in range(16)])
+        kw = dict(LOADER_KW, feature_name="", min_after_dequeue=4)
+        with native.NativeLoader([path], record_dtype="float64", **kw) as ld:
+            b = ld.next()
+            assert b.shape == (16, 8, 8, 3)
+            np.testing.assert_allclose(b, 128.0 / 127.5 - 1.0, atol=1e-6)
+
+    def test_native_label_out_of_range_errors(self, tmp_path):
+        """Labels ride a float32 slot; ids beyond 2^24 must hard-error rather
+        than silently round."""
+        native = pytest.importorskip("dcgan_tpu.data.native")
+        img = np.zeros((8, 8, 3)).astype("float64").tobytes()
+        path = str(tmp_path / "big.tfrecord")
+        tfrecord.write_tfrecords(path, [serialize_example(
+            {"image_raw": [img], "label": [(1 << 24) + 1]})])
+        kw = dict(LOADER_KW, label_feature="label")
+        with native.NativeLoader([path], record_dtype="float64", **kw) as ld:
+            with pytest.raises(native.NativeLoaderError, match="out of range"):
+                ld.next()
+
+    def test_labeled_missing_label_feature_errors(self, tmp_path):
+        # unlabeled shards + label_feature set -> hard error, not zeros
+        paths = _write_dataset(tmp_path, n=8, shards=1)
+        kw = dict(LOADER_KW, label_feature="label")
+        native = pytest.importorskip("dcgan_tpu.data.native")
+        with native.NativeLoader(paths, record_dtype="float64", **kw) as ld:
+            with pytest.raises(native.NativeLoaderError, match="label"):
+                ld.next()
+
+    def test_python_label_out_of_range_errors(self, tmp_path):
+        # the fallback loader enforces the same bound as the native one
+        img = np.zeros((8, 8, 3)).astype("float64").tobytes()
+        path = str(tmp_path / "big.tfrecord")
+        tfrecord.write_tfrecords(path, [serialize_example(
+            {"image_raw": [img], "label": [-1]})])
+        ld = PythonLoader([path], record_dtype="float64",
+                          **dict(LOADER_KW, label_feature="label"))
+        try:
+            with pytest.raises(RuntimeError, match="out of range"):
+                ld.next()
+        finally:
+            ld.close()
+
 
 class TestPipeline:
     def test_shard_for_process(self):
@@ -179,8 +254,44 @@ class TestPipeline:
         b2 = next(it)
         assert b2.shape == (16, 8, 8, 3)
 
+    def test_make_dataset_labeled_delivery(self, tmp_path):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from dcgan_tpu.parallel import make_mesh
+        write_image_tfrecords(
+            str(tmp_path / "data"), num_examples=48, image_size=8,
+            channels=3, num_shards=3, num_classes=4)
+        cfg = DataConfig(data_dir=str(tmp_path / "data"), image_size=8,
+                         batch_size=16, min_after_dequeue=8, n_threads=2,
+                         label_feature="label")
+        mesh = make_mesh()
+        sh = NamedSharding(mesh, P("data", None, None, None))
+        lsh = NamedSharding(mesh, P("data"))
+        imgs, labels = next(make_dataset(cfg, sh, lsh))
+        assert imgs.shape == (16, 8, 8, 3) and imgs.sharding == sh
+        assert labels.shape == (16,) and labels.sharding == lsh
+        assert (np.asarray(labels) < 4).all()
+
+    def test_make_dataset_labeled_requires_label_sharding(self, tmp_path):
+        write_image_tfrecords(
+            str(tmp_path / "data"), num_examples=8, image_size=8,
+            channels=3, num_shards=1, num_classes=4)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from dcgan_tpu.parallel import make_mesh
+        cfg = DataConfig(data_dir=str(tmp_path / "data"), image_size=8,
+                         batch_size=8, min_after_dequeue=4,
+                         label_feature="label")
+        sh = NamedSharding(make_mesh(), P("data", None, None, None))
+        with pytest.raises(ValueError, match="label_sharding"):
+            next(make_dataset(cfg, sh))
+
     def test_synthetic_batches(self):
         it = synthetic_batches(4, image_size=8)
         b = next(it)
         assert b.shape == (4, 8, 8, 3) and b.dtype == np.float32
         assert -1.0 <= b.min() and b.max() <= 1.0
+
+    def test_synthetic_labeled_batches(self):
+        imgs, labels = next(synthetic_batches(4, image_size=8, num_classes=5))
+        assert imgs.shape == (4, 8, 8, 3)
+        assert labels.shape == (4,) and labels.dtype == np.int32
+        assert (labels < 5).all()

@@ -110,3 +110,19 @@ class TestTrainLoop:
                        sample_every_steps=0)
         state = train(cfg, max_steps=3)
         assert int(jax.device_get(state["step"])) == 3
+
+    def test_conditional_real_labeled_tfrecords(self, tmp_path):
+        """Conditional slice over labeled shards: int64 `label` feature ->
+        native loader -> sharded (images, labels) -> conditional train step."""
+        from dcgan_tpu.data.synthetic import write_image_tfrecords
+        write_image_tfrecords(str(tmp_path / "data"), num_examples=64,
+                              image_size=16, num_shards=2, num_classes=4)
+        cfg = tiny_cfg(tmp_path,
+                       model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                                         num_classes=4,
+                                         compute_dtype="float32"),
+                       data_dir=str(tmp_path / "data"),
+                       shuffle_buffer=16, num_loader_threads=2,
+                       sample_every_steps=0)
+        state = train(cfg, max_steps=3)
+        assert int(jax.device_get(state["step"])) == 3
