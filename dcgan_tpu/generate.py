@@ -1,0 +1,197 @@
+"""Standalone generation CLI: checkpoint -> images, no training loop.
+
+The reference has NO standalone inference path — its `sampler` lives inside
+the train graph and only runs as a side effect of training (SURVEY.md §3.4:
+"There is no standalone inference/serve entry point"; image_train.py:179-192).
+This module is that missing entry point:
+
+    python -m dcgan_tpu.generate --checkpoint_dir ckpt --num_images 64
+    python -m dcgan_tpu.generate --checkpoint_dir ckpt --preset cifar10-cond \
+        --class_id 3 --num_images 256 --npz out.npz --platform cpu
+
+Writes 8x8 PNG grids (the reference's sample-grid format, image_train.py:
+197-215) into --out_dir and, optionally, the raw batch as float32 .npz in
+tanh range. Conditional checkpoints take --class_id (one class) or default to
+cycling all classes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from typing import List, Optional
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="dcgan_tpu.generate",
+                                description="generate images from a "
+                                            "trained checkpoint")
+    p.add_argument("--checkpoint_dir", required=True)
+    p.add_argument("--out_dir", default="generated")
+    p.add_argument("--num_images", type=int, default=64)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--grid", default="8x8",
+                   help="RxC tiling per PNG (e.g. 8x8); 0 disables PNGs")
+    p.add_argument("--npz", default=None,
+                   help="also dump all images (and labels) to this .npz")
+    # model architecture — must match the checkpoint. Defaults are None so
+    # "explicitly passed" is distinguishable from "omitted" when a --preset
+    # supplies the base architecture; omitted flags fall back to the preset's
+    # values, else to ModelConfig defaults (64x64, gf=df=64, z=100).
+    p.add_argument("--preset", default=None,
+                   help="named config (presets.py) supplying the model "
+                        "architecture; explicit flags override")
+    p.add_argument("--output_size", type=int, default=None)
+    p.add_argument("--c_dim", type=int, default=None)
+    p.add_argument("--z_dim", type=int, default=None)
+    p.add_argument("--gf_dim", type=int, default=None)
+    p.add_argument("--df_dim", type=int, default=None)
+    p.add_argument("--num_classes", type=int, default=None)
+    p.add_argument("--class_id", type=int, default=None,
+                   help="conditional models: generate only this class "
+                        "(default: cycle all classes)")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None)
+    return p
+
+
+_MODEL_FLAGS = ("output_size", "c_dim", "z_dim", "gf_dim", "df_dim",
+                "num_classes")
+
+
+def _model_config(args: argparse.Namespace):
+    import dataclasses
+
+    from dcgan_tpu.config import ModelConfig
+
+    if args.preset:
+        from dcgan_tpu.presets import get_preset
+        base = get_preset(args.preset).model
+    else:
+        base = ModelConfig()
+    given = {name: getattr(args, name) for name in _MODEL_FLAGS
+             if getattr(args, name) is not None}
+    return dataclasses.replace(base, **given)
+
+
+def generate(args: argparse.Namespace) -> dict:
+    """Runs generation; returns {"num_images", "step", "paths"}."""
+    import jax
+
+    from dcgan_tpu.config import TrainConfig
+    from dcgan_tpu.parallel import make_mesh, make_parallel_train
+    from dcgan_tpu.utils.checkpoint import Checkpointer
+    from dcgan_tpu.utils.images import save_sample_grid
+
+    mcfg = _model_config(args)
+    if args.batch_size < 1:
+        raise SystemExit(f"--batch_size must be >= 1, got {args.batch_size}")
+    if args.num_images < 1:
+        raise SystemExit(f"--num_images must be >= 1, got {args.num_images}")
+    if args.class_id is not None:
+        if not mcfg.num_classes:
+            raise SystemExit("--class_id requires a conditional model "
+                             "(--num_classes > 0)")
+        if not 0 <= args.class_id < mcfg.num_classes:
+            raise SystemExit(
+                f"--class_id {args.class_id} out of range "
+                f"[0, {mcfg.num_classes}) — an out-of-range id would one-hot "
+                "to all zeros and generate unconditioned images")
+    grid = None
+    if args.grid and args.grid != "0":
+        try:
+            rows, cols = (int(v) for v in args.grid.lower().split("x"))
+        except ValueError:
+            raise SystemExit(f"--grid must be RxC (e.g. 8x8) or 0, "
+                             f"got {args.grid!r}") from None
+        if rows < 1 or cols < 1:
+            raise SystemExit(f"--grid dimensions must be >= 1, "
+                             f"got {args.grid!r}")
+        grid = (rows, cols)
+
+    cfg = TrainConfig(model=mcfg, batch_size=args.batch_size,
+                      checkpoint_dir=args.checkpoint_dir)
+    mesh = make_mesh(cfg.mesh)
+    pt = make_parallel_train(cfg, mesh)
+
+    state = pt.init(jax.random.key(0))
+    restored = Checkpointer(args.checkpoint_dir).restore_latest(state)
+    if restored is None:
+        raise SystemExit(f"no checkpoint under {args.checkpoint_dir}")
+    state = restored
+    step = int(jax.device_get(state["step"]))
+
+    # batch must tile the data axis for the sharded sample fn
+    data_axis = mesh.shape["data"]
+    batch = -(-args.batch_size // data_axis) * data_axis
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    key = jax.random.key(args.seed)
+    all_imgs: List[np.ndarray] = []
+    all_labels: List[np.ndarray] = []
+    made = 0
+    batch_idx = 0
+    while made < args.num_images:
+        z = jax.random.uniform(jax.random.fold_in(key, batch_idx),
+                               (batch, mcfg.z_dim), minval=-1.0, maxval=1.0)
+        if mcfg.num_classes:
+            if args.class_id is not None:
+                labels = np.full((batch,), args.class_id, dtype=np.int32)
+            else:
+                labels = np.arange(batch_idx * batch,
+                                   batch_idx * batch + batch,
+                                   dtype=np.int32) % mcfg.num_classes
+            imgs = jax.device_get(pt.sample(state, z, jax.numpy.asarray(labels)))
+        else:
+            labels = None
+            imgs = jax.device_get(pt.sample(state, z))
+        take = min(batch, args.num_images - made)
+        all_imgs.append(np.asarray(imgs[:take], dtype=np.float32))
+        if labels is not None:
+            all_labels.append(labels[:take])
+        made += take
+        batch_idx += 1
+
+    images = np.concatenate(all_imgs)
+    paths: List[str] = []
+    if grid:
+        # tile from the full pool, not per generation batch, so grids larger
+        # than batch_size still get written
+        cells = grid[0] * grid[1]
+        for chunk in range(len(images) // cells):
+            path = os.path.join(args.out_dir,
+                                f"gen_{step:08d}_{chunk:04d}.png")
+            save_sample_grid(path, images[chunk * cells:(chunk + 1) * cells],
+                             grid)
+            paths.append(path)
+        if not paths:
+            import sys
+            print(f"[dcgan_tpu.generate] warning: no PNGs written — "
+                  f"--num_images {args.num_images} < grid {grid[0]}x{grid[1]} "
+                  f"({cells} cells); lower --grid or raise --num_images",
+                  file=sys.stderr)
+
+    if args.npz:
+        arrays = {"images": images}
+        if all_labels:
+            arrays["labels"] = np.concatenate(all_labels)
+        np.savez(args.npz, **arrays)
+        paths.append(args.npz)
+    return {"num_images": made, "step": step, "paths": paths}
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    result = generate(args)
+    print(f"[dcgan_tpu.generate] {result['num_images']} images from "
+          f"checkpoint step {result['step']} -> "
+          f"{result['paths'][-1] if result['paths'] else args.out_dir}")
+
+
+if __name__ == "__main__":
+    main()

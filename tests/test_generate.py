@@ -1,0 +1,138 @@
+"""Standalone generation CLI (dcgan_tpu/generate.py) — the serve entry point
+the reference never had (SURVEY.md §3.4)."""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from dcgan_tpu.config import ModelConfig, TrainConfig
+from dcgan_tpu.generate import build_parser, generate
+from dcgan_tpu.train.trainer import train
+
+
+@pytest.fixture(scope="module")
+def trained_ckpt(tmp_path_factory):
+    root = tmp_path_factory.mktemp("gen")
+    cfg = TrainConfig(
+        model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                          compute_dtype="float32"),
+        batch_size=8,
+        checkpoint_dir=str(root / "ckpt"),
+        sample_dir=str(root / "samples"),
+        sample_every_steps=0, save_summaries_secs=1e9, save_model_secs=1e9,
+        log_every_steps=0)
+    train(cfg, synthetic_data=True, max_steps=1)
+    return str(root / "ckpt")
+
+
+class TestGenerate:
+    def test_grids_and_npz(self, trained_ckpt, tmp_path):
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", trained_ckpt,
+             "--out_dir", str(tmp_path / "out"),
+             "--num_images", "10", "--batch_size", "8", "--grid", "2x2",
+             "--npz", str(tmp_path / "gen.npz"),
+             "--output_size", "16", "--gf_dim", "8", "--df_dim", "8"])
+        result = generate(args)
+        assert result["num_images"] == 10
+        assert result["step"] == 1
+        assert glob.glob(str(tmp_path / "out" / "gen_*.png"))
+        data = np.load(tmp_path / "gen.npz")
+        assert data["images"].shape == (10, 16, 16, 3)
+        assert data["images"].dtype == np.float32
+        assert np.abs(data["images"]).max() <= 1.0
+        assert "labels" not in data
+
+    def test_no_checkpoint_errors(self, tmp_path):
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", str(tmp_path / "nope"),
+             "--output_size", "16", "--gf_dim", "8", "--df_dim", "8"])
+        with pytest.raises(SystemExit, match="no checkpoint"):
+            generate(args)
+
+    def test_conditional_class_id(self, tmp_path):
+        cfg = TrainConfig(
+            model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                              num_classes=4, compute_dtype="float32"),
+            batch_size=8,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            sample_dir=str(tmp_path / "samples"),
+            sample_every_steps=0, save_summaries_secs=1e9,
+            save_model_secs=1e9, log_every_steps=0)
+        train(cfg, synthetic_data=True, max_steps=1)
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", str(tmp_path / "ckpt"),
+             "--out_dir", str(tmp_path / "out"), "--num_images", "8",
+             "--batch_size", "8", "--grid", "0",
+             "--npz", str(tmp_path / "gen.npz"),
+             "--output_size", "16", "--gf_dim", "8", "--df_dim", "8",
+             "--num_classes", "4", "--class_id", "2"])
+        result = generate(args)
+        assert result["num_images"] == 8
+        data = np.load(tmp_path / "gen.npz")
+        assert (data["labels"] == 2).all()
+
+    @pytest.mark.parametrize("argv,match", [
+        (["--batch_size", "0"], "batch_size"),
+        (["--num_images", "-3"], "num_images"),
+        (["--grid", "0x0"], "grid"),
+        (["--grid", "8"], "grid"),
+    ])
+    def test_bad_arguments_rejected(self, tmp_path, argv, match):
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", str(tmp_path / "ckpt")] + argv)
+        with pytest.raises(SystemExit, match=match):
+            generate(args)
+
+    def test_class_id_out_of_range_errors(self, tmp_path):
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", str(tmp_path / "ckpt"),
+             "--num_classes", "4", "--class_id", "42"])
+        with pytest.raises(SystemExit, match="out of range"):
+            generate(args)
+
+    def test_class_id_without_conditional_model_errors(self, tmp_path):
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", str(tmp_path / "ckpt"), "--class_id", "0"])
+        with pytest.raises(SystemExit, match="conditional"):
+            generate(args)
+
+    def test_explicit_flag_equal_to_global_default_beats_preset(self):
+        from dcgan_tpu.generate import _model_config
+        # 64 is both the global default and explicitly passed; the preset's
+        # 32 must NOT win
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", "x", "--preset", "cifar10-cond",
+             "--output_size", "64"])
+        mcfg = _model_config(args)
+        assert mcfg.output_size == 64
+        assert mcfg.num_classes == 10  # untouched preset field survives
+
+    def test_grid_larger_than_batch_written_from_pool(self, trained_ckpt,
+                                                      tmp_path):
+        # grid cells (4x4=16) > batch_size (8): tiles must come from the
+        # accumulated pool, not be silently skipped
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", trained_ckpt,
+             "--out_dir", str(tmp_path / "out"),
+             "--num_images", "32", "--batch_size", "8", "--grid", "4x4",
+             "--output_size", "16", "--gf_dim", "8", "--df_dim", "8"])
+        result = generate(args)
+        pngs = glob.glob(str(tmp_path / "out" / "gen_*.png"))
+        assert len(pngs) == 2  # 32 images / 16 cells
+        assert set(result["paths"]) == set(pngs)
+
+    def test_preset_architecture_with_overrides(self, trained_ckpt, tmp_path):
+        # preset supplies the architecture; explicit flags shrink it to match
+        # the tiny checkpoint
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", trained_ckpt, "--preset", "celeba64",
+             "--out_dir", str(tmp_path / "out"), "--num_images", "4",
+             "--batch_size", "8", "--grid", "0",
+             "--npz", str(tmp_path / "gen.npz"),
+             "--output_size", "16", "--gf_dim", "8", "--df_dim", "8"])
+        result = generate(args)
+        assert result["num_images"] == 4
+        assert np.load(tmp_path / "gen.npz")["images"].shape == (4, 16, 16, 3)
