@@ -103,6 +103,11 @@ class TrainConfig:
     max_steps: int = 1_200_000     # (image_train.py:150)
     loss: str = "gan"              # "gan" (BCE, image_train.py:91-96) | "wgan-gp"
     gp_weight: float = 10.0        # WGAN-GP gradient-penalty coefficient
+    n_critic: int = 1              # D updates per G update. 1 = the reference's
+                                   # one-D-one-G step (image_train.py:156-158);
+                                   # WGAN-GP canonically uses 5 (each critic
+                                   # iteration draws fresh z against the same
+                                   # real batch, scanned in-program)
     update_mode: str = "sequential"  # "sequential": D step then G step (intended
                                      # semantics); "fused": both grads from the same
                                      # params, applied together (reference parity,
@@ -148,3 +153,9 @@ class TrainConfig:
             raise ValueError(f"unknown loss {self.loss!r}")
         if self.update_mode not in ("sequential", "fused"):
             raise ValueError(f"unknown update_mode {self.update_mode!r}")
+        if self.n_critic < 1:
+            raise ValueError(f"n_critic must be >= 1, got {self.n_critic}")
+        if self.n_critic > 1 and self.update_mode == "fused":
+            raise ValueError(
+                "update_mode='fused' (reference-parity single fused step) is "
+                "defined only for n_critic=1")

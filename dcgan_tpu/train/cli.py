@@ -38,6 +38,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--loss", choices=["gan", "wgan-gp"], default="gan")
     p.add_argument("--update_mode", choices=["sequential", "fused"],
                    default="sequential")
+    p.add_argument("--n_critic", type=int, default=1,
+                   help="D updates per G update (WGAN-GP canonical: 5)")
+    p.add_argument("--gp_weight", type=float, default=10.0,
+                   help="WGAN-GP gradient-penalty coefficient")
     # model (image_train.py:15-18 — wired here, unlike the reference)
     p.add_argument("--output_size", type=int, default=64)
     p.add_argument("--c_dim", type=int, default=3)
@@ -93,6 +97,7 @@ _FLAG_FIELDS = {
     "learning_rate": ("", "learning_rate"), "beta1": ("", "beta1"),
     "batch_size": ("", "batch_size"), "max_steps": ("", "max_steps"),
     "loss": ("", "loss"), "update_mode": ("", "update_mode"),
+    "n_critic": ("", "n_critic"), "gp_weight": ("", "gp_weight"),
     "dataset": ("", "dataset"), "data_dir": ("", "data_dir"),
     "sample_image_dir": ("", "sample_image_dir"),
     "record_dtype": ("", "record_dtype"),

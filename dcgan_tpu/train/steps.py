@@ -17,6 +17,10 @@ Two update modes (TrainConfig.update_mode):
   updates applied together — the reference's actual one-sess.run semantics,
   kept behind a flag for strict-parity experiments.
 
+TrainConfig.n_critic > 1 (canonical WGAN-GP: 5) runs that many critic updates
+per generator update as a lax.scan inside the same compiled program — fresh z
+per critic iteration, same real batch, critic body compiled once.
+
 Under jit-with-sharding (parallel/), gradient all-reduce and synced-BN moments
 are inserted by GSPMD; for explicit-collective execution (shard_map) pass
 `axis_name` and grads/metrics are pmean'd by hand. Both replace the reference's
@@ -142,14 +146,49 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None
 
         params, bn = state["params"], state["bn"]
 
-        # --- D step ---------------------------------------------------------
-        (d_loss, (d_bn, d_real, d_fake, gp)), d_grads = jax.value_and_grad(
-            d_loss_fn, has_aux=True)(
-                params["disc"], params["gen"], bn, images, z, gp_key, labels)
-        d_grads = _pmean(d_grads)
-        d_updates, d_opt = opt.update(d_grads, state["opt"]["disc"],
-                                      params["disc"])
-        new_disc = optax.apply_updates(params["disc"], d_updates)
+        # --- D step(s) ------------------------------------------------------
+        if cfg.n_critic == 1:
+            (d_loss, (d_bn, d_real, d_fake, gp)), d_grads = jax.value_and_grad(
+                d_loss_fn, has_aux=True)(
+                    params["disc"], params["gen"], bn, images, z, gp_key,
+                    labels)
+            d_grads = _pmean(d_grads)
+            d_updates, d_opt = opt.update(d_grads, state["opt"]["disc"],
+                                          params["disc"])
+            new_disc = optax.apply_updates(params["disc"], d_updates)
+        else:
+            # n_critic > 1 (canonical WGAN-GP: 5) — scanned critic updates
+            # inside the same compiled program. Each iteration draws fresh z
+            # (and a fresh interpolation key) against the same real batch;
+            # the loop is lax.scan so XLA compiles the critic body once.
+            def critic_iter(carry, iter_key):
+                d_params_c, d_opt_c, d_bn_c, _ = carry
+                zk, gpk = jax.random.split(iter_key)
+                z_i = jax.random.uniform(
+                    zk, (images.shape[0], mcfg.z_dim),
+                    minval=-1.0, maxval=1.0, dtype=jnp.float32)
+                bn_in = {"gen": bn["gen"], "disc": d_bn_c}
+                (loss_i, (bn_i, real_i, fake_i, gp_i)), grads = \
+                    jax.value_and_grad(d_loss_fn, has_aux=True)(
+                        d_params_c, params["gen"], bn_in, images, z_i, gpk,
+                        labels)
+                grads = _pmean(grads)
+                updates, d_opt_c = opt.update(grads, d_opt_c, d_params_c)
+                d_params_c = optax.apply_updates(d_params_c, updates)
+                # last iteration's metrics ride the carry; note they are
+                # evaluated at that iteration's PRE-update params (one Adam
+                # step stale relative to the critic G trains against)
+                return ((d_params_c, d_opt_c, bn_i,
+                         (loss_i, real_i, fake_i, gp_i)), None)
+
+            iter_keys = jax.random.split(gp_key, cfg.n_critic)
+            zero = jnp.zeros((), jnp.float32)
+            (new_disc, d_opt, d_bn,
+             (d_loss, d_real, d_fake, gp)), _ = lax.scan(
+                critic_iter,
+                (params["disc"], state["opt"]["disc"], bn["disc"],
+                 (zero, zero, zero, zero)),
+                iter_keys)
 
         if cfg.update_mode == "sequential":
             g_target_disc = new_disc

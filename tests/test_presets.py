@@ -40,6 +40,7 @@ class TestPresets:
         cfg = get_preset("wgan-gp")
         assert cfg.loss == "wgan-gp"
         assert cfg.learning_rate == 1e-4 and cfg.beta1 == 0.0
+        assert cfg.n_critic == 5
 
     def test_factory_overrides(self):
         cfg = get_preset("celeba64", batch_size=128, seed=7)

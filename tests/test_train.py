@@ -126,6 +126,34 @@ class TestTrainStep:
         assert "gp" in m and np.isfinite(float(m["gp"]))
         assert np.isfinite(float(m["d_loss"]))
 
+    def test_n_critic_scan(self):
+        """n_critic=3 runs three scanned critic updates per step: the critic
+        params must move further than a single-update step from the same
+        state/batch, and the step counter still advances by one."""
+        xs, key = real_batch(), jax.random.key(1)
+        states = {}
+        for n in (1, 3):
+            fns = make_train_step(tiny_cfg(loss="wgan-gp", n_critic=n))
+            s = fns.init(jax.random.key(0))
+            s1, m = jax.jit(fns.train_step)(s, xs, key)
+            assert int(s1["step"]) == 1
+            assert np.isfinite(float(m["d_loss"]))
+            states[n] = (s, s1)
+        s0, one = states[1]
+        _, three = states[3]
+
+        def total_move(a, b):
+            return sum(float(jnp.sum(jnp.abs(x - y))) for x, y in zip(
+                jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)))
+        assert total_move(s0["params"]["disc"], three["params"]["disc"]) > \
+            total_move(s0["params"]["disc"], one["params"]["disc"])
+
+    def test_n_critic_fused_rejected(self):
+        with pytest.raises(ValueError):
+            tiny_cfg(n_critic=3, update_mode="fused")
+        with pytest.raises(ValueError):
+            tiny_cfg(n_critic=0)
+
     def test_determinism(self):
         """Fixed PRNG key -> bitwise-identical step on CPU (SURVEY.md §4)."""
         fns = make_train_step(tiny_cfg())
