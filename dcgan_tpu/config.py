@@ -136,6 +136,13 @@ class TrainConfig:
     sample_every_steps: int = 100
     sample_grid: Tuple[int, int] = (8, 8)   # 8x8 grid (image_train.py:205)
     log_every_steps: int = 1
+    activation_summary_steps: int = 500  # per-layer activation histogram +
+                                         # sparsity cadence (0 = off). Step-
+                                         # gated, not time-gated: the summary
+                                         # program is a mesh collective, so
+                                         # every process must agree on when it
+                                         # runs (a per-process clock gate would
+                                         # deadlock multi-host)
 
     # Profiling (SURVEY.md §5 — the reference has none; jax.profiler + step
     # timing is the named TPU-native equivalent)

@@ -91,13 +91,20 @@ def generator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
 def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
                     cfg: ModelConfig, train: bool,
                     labels: Optional[jax.Array] = None,
-                    axis_name: Optional[str] = None
+                    axis_name: Optional[str] = None,
+                    capture: Optional[dict] = None
                     ) -> Tuple[jax.Array, Pytree]:
     """z [B, z_dim] (-1..1) -> image [B, S, S, c_dim] in tanh range.
 
     train=True uses batch BN statistics and returns updated EMA state;
     train=False is the reference's `sampler` path (running stats, state
     unchanged).
+
+    `capture`, when a dict, receives every post-activation tensor keyed
+    "h0".."hk" — the functional replacement for the reference's
+    `_activation_summary` calls inside the layer stack
+    (distriubted_model.py:75-80,94-110); callers turn them into
+    histogram/sparsity summaries (utils/metrics.py).
     """
     k = cfg.num_up_layers
     cdt = _cdtype(cfg)
@@ -117,6 +124,8 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
         params["bn0"], state["bn0"], h, train=train,
         momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name,
         act="relu", use_pallas=cfg.use_pallas)
+    if capture is not None:
+        capture["h0"] = h
 
     for i in range(1, k + 1):
         h = deconv2d_apply(params[f"deconv{i}"], h, compute_dtype=cdt)
@@ -125,8 +134,13 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
                 params[f"bn{i}"], state[f"bn{i}"], h, train=train,
                 momentum=cfg.bn_momentum, eps=cfg.bn_eps,
                 axis_name=axis_name, act="relu", use_pallas=cfg.use_pallas)
+            if capture is not None:
+                capture[f"h{i}"] = h
 
-    return jnp.tanh(h.astype(jnp.float32)), new_state
+    out = jnp.tanh(h.astype(jnp.float32))
+    if capture is not None:
+        capture[f"h{k}"] = out
+    return out, new_state
 
 
 def sampler_apply(params: Pytree, state: Pytree, z: jax.Array, *,
@@ -171,9 +185,14 @@ def discriminator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
 def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
                         cfg: ModelConfig, train: bool,
                         labels: Optional[jax.Array] = None,
-                        axis_name: Optional[str] = None
+                        axis_name: Optional[str] = None,
+                        capture: Optional[dict] = None
                         ) -> Tuple[jax.Array, jax.Array, Pytree]:
-    """image [B, S, S, c] -> (sigmoid(logit), logit [B, 1], new_bn_state)."""
+    """image [B, S, S, c] -> (sigmoid(logit), logit [B, 1], new_bn_state).
+
+    `capture` (dict) receives post-activation tensors "h0".."h{k-1}" plus the
+    final "logit" — see generator_apply.
+    """
     k = cfg.num_up_layers
     cdt = _cdtype(cfg)
     new_state: Pytree = {}
@@ -198,10 +217,14 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
                 use_pallas=cfg.use_pallas)
         else:
             h = lrelu(h, cfg.leak)
+        if capture is not None:
+            capture[f"h{i}"] = h
 
     h = h.reshape(h.shape[0], -1)
     logit = linear_apply(params["head"], h, compute_dtype=cdt)
     logit = logit.astype(jnp.float32)
+    if capture is not None:
+        capture["logit"] = logit
     return jax.nn.sigmoid(logit), logit, new_state
 
 

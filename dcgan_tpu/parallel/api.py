@@ -43,6 +43,7 @@ class ParallelTrain:
     init: Callable
     step: Callable
     sample: Callable
+    summarize: Callable  # (state, images, key[, labels]) -> activation stats
 
 
 def make_parallel_train(cfg: TrainConfig,
@@ -78,6 +79,10 @@ def make_parallel_train(cfg: TrainConfig,
             fns.sample,
             in_shardings=(shardings, z_sh, lbl_sh),
             out_shardings=rep)
+        summarize = jax.jit(
+            fns.summarize,
+            in_shardings=(shardings, img_sh, rep, lbl_sh),
+            out_shardings=rep)
     else:
         step = jax.jit(
             fns.train_step,
@@ -88,6 +93,11 @@ def make_parallel_train(cfg: TrainConfig,
             fns.sample,
             in_shardings=(shardings, z_sh),
             out_shardings=rep)
+        summarize = jax.jit(
+            fns.summarize,
+            in_shardings=(shardings, img_sh, rep),
+            out_shardings=rep)
 
     return ParallelTrain(mesh=mesh, cfg=cfg, shardings=shardings,
-                         init=init, step=step, sample=sample)
+                         init=init, step=step, sample=sample,
+                         summarize=summarize)

@@ -72,6 +72,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save_summaries_secs", type=float, default=10.0)
     p.add_argument("--save_model_secs", type=float, default=600.0)
     p.add_argument("--sample_every_steps", type=int, default=100)
+    p.add_argument("--activation_summary_steps", type=int, default=500,
+                   help="per-layer activation histogram cadence (0 = off)")
     # profiling (SURVEY.md §5 — trace capture the reference never had)
     p.add_argument("--profile_dir", default="",
                    help="capture a jax.profiler trace into this dir")
@@ -106,6 +108,7 @@ _FLAG_FIELDS = {
     "save_summaries_secs": ("", "save_summaries_secs"),
     "save_model_secs": ("", "save_model_secs"),
     "sample_every_steps": ("", "sample_every_steps"),
+    "activation_summary_steps": ("", "activation_summary_steps"),
     "profile_dir": ("", "profile_dir"),
     "profile_start_step": ("", "profile_start_step"),
     "profile_num_steps": ("", "profile_num_steps"),

@@ -80,6 +80,8 @@ class TrainStepFns:
     train_step: Callable  # (state, images, key[, labels]) -> (state, metrics)
     sample: Callable      # (state, z[, labels]) -> images (EMA-stat BN)
     init: Callable        # (key,) -> state
+    summarize: Callable   # (state, images, key[, labels]) -> per-layer
+                          # activation histogram/sparsity stats (on device)
 
 
 def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None
@@ -229,7 +231,34 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None
         return sampler_apply(state["params"]["gen"], state["bn"]["gen"], z,
                              cfg=mcfg, labels=labels)
 
+    def summarize(state: Pytree, images: jax.Array, key: jax.Array,
+                  labels: Optional[jax.Array] = None) -> dict:
+        """Per-layer activation histograms + sparsity, reduced on device.
+
+        The functional replacement for the reference's `_activation_summary`
+        (distriubted_model.py:75-80): one extra forward of G and of D (on the
+        real batch) with train-mode BN, run on a step-count cadence
+        (TrainConfig.activation_summary_steps — never a per-process time gate;
+        it is a mesh collective) — the hot step is untouched.
+        """
+        from dcgan_tpu.utils.metrics import activation_stats
+
+        params, bn = state["params"], state["bn"]
+        z = jax.random.uniform(key, (images.shape[0], mcfg.z_dim),
+                               minval=-1.0, maxval=1.0, dtype=jnp.float32)
+        g_cap: dict = {}
+        d_cap: dict = {}
+        generator_apply(params["gen"], bn["gen"], z, cfg=mcfg, train=True,
+                        labels=labels, axis_name=axis_name, capture=g_cap)
+        discriminator_apply(params["disc"], bn["disc"], images, cfg=mcfg,
+                            train=True, labels=labels, axis_name=axis_name,
+                            capture=d_cap)
+        acts = {**{f"gen/{k}": v for k, v in g_cap.items()},
+                **{f"disc/{k}": v for k, v in d_cap.items()}}
+        return activation_stats(acts)
+
     def init(key):
         return init_train_state(key, cfg)
 
-    return TrainStepFns(train_step=train_step, sample=sample, init=init)
+    return TrainStepFns(train_step=train_step, sample=sample, init=init,
+                        summarize=summarize)

@@ -132,11 +132,13 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
     for step_num in range(start_step, total_steps):
         trace.maybe_start(step_num)
         key = jax.random.fold_in(base_key, step_num)
+        labels = None
         if conditional:
             images, labels = next(data)
             state, metrics = pt.step(state, images, key, labels)
         else:
-            state, metrics = pt.step(state, next(data), key)
+            images = next(data)
+            state, metrics = pt.step(state, images, key)
         new_step = step_num + 1
 
         if chief and cfg.log_every_steps and \
@@ -157,6 +159,17 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
                                   **timer.summary()})
             writer.write_histograms(
                 new_step, param_histograms(jax.device_get(state["params"])))
+
+        # per-layer activation histograms + sparsity (the reference's
+        # _activation_summary channel, distriubted_model.py:75-80). Runs on
+        # every process — it is a compiled mesh program — chief writes.
+        if cfg.activation_summary_steps and \
+                new_step % cfg.activation_summary_steps == 0:
+            acts = pt.summarize(state, images, jax.random.fold_in(key, 1),
+                                labels) if conditional else \
+                pt.summarize(state, images, jax.random.fold_in(key, 1))
+            if chief:
+                writer.write_activations(new_step, jax.device_get(acts))
 
         if cfg.sample_every_steps and new_step % cfg.sample_every_steps == 0:
             imgs = jax.device_get(pt.sample(state, sample_z, sample_labels)

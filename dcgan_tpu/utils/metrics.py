@@ -38,6 +38,37 @@ def histogram_summary(values, bins: int = 30) -> Dict[str, Any]:
     }
 
 
+def activation_stats(acts: Mapping[str, Any], bins: int = 30
+                     ) -> Dict[str, Dict[str, Any]]:
+    """Device-side histogram + sparsity per activation tensor.
+
+    The reference ships every activation tensor to the summary writer
+    (distriubted_model.py:79-80); here the reduction happens on device inside
+    the jitted summary program — only ~2*bins scalars per layer cross to the
+    host. Returns {name: {min,max,mean,std,zero_fraction,bin_counts,bin_edges}}
+    of jnp values; MetricWriter.write_activations converts to JSON.
+    """
+    import jax.numpy as jnp
+
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, x in acts.items():
+        v = x.astype(jnp.float32).ravel()
+        counts, edges = jnp.histogram(v, bins=bins)
+        out[name] = {
+            "count": v.size,
+            "min": jnp.min(v),
+            "max": jnp.max(v),
+            "mean": jnp.mean(v),
+            "std": jnp.std(v),
+            # the reference's per-layer sparsity scalar
+            # (tf.nn.zero_fraction, distriubted_model.py:80)
+            "zero_fraction": jnp.mean(v == 0.0),
+            "bin_counts": counts,
+            "bin_edges": edges,
+        }
+    return out
+
+
 class MetricWriter:
     """Chief-only, time-throttled event writer.
 
@@ -84,6 +115,23 @@ class MetricWriter:
         self._emit("histograms", step,
                    {"values": {k: histogram_summary(v, bins)
                                for k, v in tensors.items()}})
+
+    def write_activations(self, step: int,
+                          stats: Mapping[str, Mapping[str, Any]]) -> None:
+        """Emit precomputed per-layer activation stats (activation_stats
+        output, already reduced on device)."""
+        def conv(rec):
+            out = {}
+            for k, v in rec.items():
+                a = np.asarray(v)
+                if a.ndim:  # bin_counts stay ints, matching histogram_summary
+                    cast = int if k == "bin_counts" else float
+                    out[k] = [cast(x) for x in a.ravel()]
+                else:
+                    out[k] = int(a) if k == "count" else float(a)
+            return out
+        self._emit("activations", step,
+                   {"values": {k: conv(rec) for k, rec in stats.items()}})
 
     def write_image_event(self, step: int, name: str, path: str) -> None:
         """Record that an image artifact was written (the grid PNG itself is

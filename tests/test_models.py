@@ -110,3 +110,27 @@ def test_gan_init_partitions_params():
     p, s = gan_init(jax.random.key(0), CFG)
     assert set(p.keys()) == {"gen", "disc"}
     assert set(s.keys()) == {"gen", "disc"}
+
+
+def test_activation_capture():
+    """capture= collects every post-activation tensor (the reference's
+    _activation_summary taps, distriubted_model.py:75-80,94-110)."""
+    p, s = gan_init(jax.random.key(0), CFG)
+    z = jax.random.uniform(jax.random.key(1), (4, 100), minval=-1, maxval=1)
+    g_cap, d_cap = {}, {}
+    img, _ = generator_apply(p["gen"], s["gen"], z, cfg=CFG, train=True,
+                             capture=g_cap)
+    discriminator_apply(p["disc"], s["disc"], img, cfg=CFG, train=True,
+                        capture=d_cap)
+    # G: h0 (4x4 post-BN-relu), h1..h3 (inner deconvs), h4 (tanh output)
+    assert set(g_cap.keys()) == {"h0", "h1", "h2", "h3", "h4"}
+    assert g_cap["h0"].shape == (4, 4, 4, 512)
+    assert g_cap["h4"].shape == (4, 64, 64, 3)
+    # relu layers have exact zeros; tanh output does not track them
+    assert float(jnp.mean(g_cap["h1"] == 0)) > 0.0
+    # D: h0..h3 conv stages + final logit
+    assert set(d_cap.keys()) == {"h0", "h1", "h2", "h3", "logit"}
+    assert d_cap["logit"].shape == (4, 1)
+    # capture must observe the very tensors the forward used (no recompute):
+    # the tanh of the last captured pre-output equals the returned image
+    np.testing.assert_array_equal(np.asarray(g_cap["h4"]), np.asarray(img))

@@ -58,7 +58,7 @@ class TestCLI:
 
 class TestTrainLoop:
     def test_synthetic_end_to_end(self, tmp_path):
-        cfg = tiny_cfg(tmp_path)
+        cfg = tiny_cfg(tmp_path, activation_summary_steps=5)
         state = train(cfg, synthetic_data=True, max_steps=7)
         assert int(jax.device_get(state["step"])) == 7
 
@@ -76,6 +76,18 @@ class TestTrainLoop:
         assert "scalars" in kinds and "histograms" in kinds and "image" in kinds
         scalar_steps = [e["step"] for e in events if e["kind"] == "scalars"]
         assert scalar_steps[0] == 1
+
+        # per-layer activation summaries at step 5 (_activation_summary parity)
+        acts = [e for e in events if e["kind"] == "activations"]
+        assert [e["step"] for e in acts] == [5]
+        layers = acts[0]["values"]
+        assert "gen/h0" in layers and "disc/h0" in layers \
+            and "disc/logit" in layers
+        rec = layers["gen/h0"]   # relu layer: sparsity in (0,1), 30-bin hist
+        assert 0.0 < rec["zero_fraction"] < 1.0
+        assert len(rec["bin_counts"]) == 30 \
+            and len(rec["bin_edges"]) == 31
+        assert sum(rec["bin_counts"]) == rec["count"]
 
         # final checkpoint exists at step 7
         from dcgan_tpu.utils.checkpoint import Checkpointer

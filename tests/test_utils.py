@@ -18,6 +18,7 @@ from dcgan_tpu.utils.images import (
 )
 from dcgan_tpu.utils.metrics import (
     MetricWriter,
+    activation_stats,
     histogram_summary,
     param_histograms,
 )
@@ -113,3 +114,29 @@ class TestCheckpoint:
         assert ck.maybe_save(2, state)
         ck.wait()
         assert ck.latest_step() == 2
+
+
+class TestActivationStats:
+    def test_device_stats_match_numpy(self):
+        x = jax.random.normal(jax.random.key(0), (64, 7)) 
+        x = jnp.where(x < 0, 0.0, x)  # relu-like: real zeros
+        stats = jax.jit(lambda a: activation_stats({"l": a}))(x)
+        rec = {k: np.asarray(v) for k, v in stats["l"].items()}
+        arr = np.asarray(x).ravel()
+        np.testing.assert_allclose(rec["mean"], arr.mean(), rtol=1e-6)
+        np.testing.assert_allclose(rec["zero_fraction"], (arr == 0).mean(),
+                                   rtol=1e-6)
+        counts, edges = np.histogram(arr, bins=30)
+        np.testing.assert_array_equal(rec["bin_counts"], counts)
+        np.testing.assert_allclose(rec["bin_edges"], edges, rtol=1e-5)
+
+    def test_write_activations_event(self, tmp_path):
+        w = MetricWriter(str(tmp_path), every_secs=0.0)
+        x = jnp.arange(12.0).reshape(3, 4)
+        w.write_activations(3, activation_stats({"gen/h0": x}, bins=4))
+        ev = json.loads(open(os.path.join(str(tmp_path),
+                                          "events.jsonl")).read())
+        assert ev["kind"] == "activations" and ev["step"] == 3
+        rec = ev["values"]["gen/h0"]
+        assert rec["count"] == 12 and len(rec["bin_counts"]) == 4
+        assert isinstance(rec["min"], float)
