@@ -68,7 +68,14 @@ class MeshConfig:
     """
 
     data: int = -1                 # data-parallel axis size; -1 = all devices
-    model: int = 1                 # tensor-parallel axis size (latent; 1 = off)
+    model: int = 1                 # second mesh axis size (1 = off)
+    spatial: bool = False          # repurpose the "model" axis for spatial
+                                   # partitioning: activations shard over image
+                                   # height (GSPMD inserts conv halo exchanges)
+                                   # and weights stay replicated — the image-
+                                   # domain analogue of sequence/context
+                                   # parallelism (SURVEY.md §2.5). False =
+                                   # tensor parallelism (wide weights shard)
 
     def axis_sizes(self, n_devices: int) -> Tuple[int, int]:
         if self.model < 1:

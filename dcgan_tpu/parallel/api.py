@@ -60,9 +60,10 @@ def make_parallel_train(cfg: TrainConfig,
     fns = make_train_step(cfg)
 
     state_shapes = jax.eval_shape(fns.init, jax.random.key(0))
-    shardings = state_shardings(state_shapes, mesh)
+    spatial = cfg.mesh.spatial
+    shardings = state_shardings(state_shapes, mesh, spatial=spatial)
     rep = replicated(mesh)
-    img_sh = batch_sharding(mesh, 4)
+    img_sh = batch_sharding(mesh, 4, spatial=spatial)
     z_sh = batch_sharding(mesh, 2)
     lbl_sh = batch_sharding(mesh, 1)
     conditional = cfg.model.num_classes > 0

@@ -33,8 +33,17 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, ndim: int = 4) -> NamedSharding:
-    """Shard dim 0 (batch) over "data"; e.g. images [B,H,W,C], labels [B]."""
+def batch_sharding(mesh: Mesh, ndim: int = 4, *,
+                   spatial: bool = False) -> NamedSharding:
+    """Shard dim 0 (batch) over "data"; e.g. images [B,H,W,C], labels [B].
+
+    spatial=True additionally shards dim 1 (image height) over "model" — the
+    sequence-parallel analogue for convolutional data: XLA lowers convs over
+    the halo-exchange pattern (ppermute of kernel_size//2 boundary rows over
+    ICI) instead of gathering full feature maps.
+    """
+    if spatial and ndim == 4 and mesh.shape[MODEL_AXIS] > 1:
+        return NamedSharding(mesh, P(DATA_AXIS, MODEL_AXIS, None, None))
     return NamedSharding(mesh, P(DATA_AXIS, *([None] * (ndim - 1))))
 
 
@@ -60,14 +69,21 @@ def _spec_for_leaf(path, leaf, model_size: int) -> P:
     return P()
 
 
-def state_shardings(state_shapes: Pytree, mesh: Mesh) -> Pytree:
+def state_shardings(state_shapes: Pytree, mesh: Mesh, *,
+                    spatial: bool = False) -> Pytree:
     """Map a ShapeDtypeStruct tree (from jax.eval_shape on init) to a matching
     tree of NamedShardings. Works for the whole train state: params and Adam
     moments (mu/nu mirror the param tree, so the same path rules hit them) get
     TP rules; BN state and counters come out replicated.
+
+    spatial=True replicates ALL weights: the "model" axis then carries the
+    height dimension of activations (batch_sharding), and sharding kernels
+    over the same axis would force GSPMD to all-gather them around every conv.
     """
     model_size = mesh.shape[MODEL_AXIS]
 
     def to_sharding(path, leaf):
+        if spatial:
+            return NamedSharding(mesh, P())
         return NamedSharding(mesh, _spec_for_leaf(path, leaf, model_size))
     return jax.tree_util.tree_map_with_path(to_sharding, state_shapes)

@@ -87,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="data-parallel axis size (-1 = all devices)")
     p.add_argument("--mesh_model", type=int, default=1,
                    help="tensor-parallel axis size")
+    p.add_argument("--mesh_spatial", action="store_true",
+                   help="use the model axis to shard image height instead of "
+                        "weights (conv halo exchange; the sequence-parallel "
+                        "analogue for image models)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. cpu for local debug; "

@@ -49,7 +49,7 @@ Pytree = Any
 def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool) -> Iterator:
     """Yields sharded image batches — (images, labels) pairs for conditional
     models (cfg.model.num_classes > 0)."""
-    sharding = batch_sharding(mesh, 4)
+    sharding = batch_sharding(mesh, 4, spatial=cfg.mesh.spatial)
     conditional = cfg.model.num_classes > 0
     label_sharding = batch_sharding(mesh, 1) if conditional else None
     if synthetic:

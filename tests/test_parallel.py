@@ -61,8 +61,29 @@ def test_sharding_rules():
                            for s in conv_mu)
 
 
-@pytest.mark.parametrize("mesh_cfg", [MeshConfig(), MeshConfig(model=2)],
-                         ids=["dp8", "dp4xtp2"])
+def test_spatial_sharding_rules():
+    """spatial=True: weights replicate; images shard over (batch, height) —
+    the sequence-parallel analogue for conv data (SURVEY.md §2.5)."""
+    from dcgan_tpu.parallel.sharding import batch_sharding
+
+    cfg = TrainConfig(model=TINY, batch_size=16,
+                      mesh=MeshConfig(model=2, spatial=True))
+    mesh = make_mesh(cfg.mesh)
+    fns = make_train_step(cfg)
+    shapes = jax.eval_shape(fns.init, jax.random.key(0))
+    sh = state_shardings(shapes, mesh, spatial=True)
+    for s in jax.tree_util.tree_leaves(sh):
+        assert s.spec == P()
+    img_sh = batch_sharding(mesh, 4, spatial=True)
+    assert img_sh.spec == P("data", "model", None, None)
+    # non-image inputs never spatial-shard
+    assert batch_sharding(mesh, 2, spatial=True).spec == P("data", None)
+
+
+@pytest.mark.parametrize("mesh_cfg",
+                         [MeshConfig(), MeshConfig(model=2),
+                          MeshConfig(model=2, spatial=True)],
+                         ids=["dp8", "dp4xtp2", "dp4xsp2"])
 def test_sharded_step_matches_single_device(mesh_cfg):
     """The sharded SPMD step must be numerically equivalent to the unsharded
     step — data parallelism here is synchronous (one global batch, global BN
