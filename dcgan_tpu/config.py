@@ -77,6 +77,14 @@ class MeshConfig:
                                    # parallelism (SURVEY.md §2.5). False =
                                    # tensor parallelism (wide weights shard)
 
+    def __post_init__(self):
+        if self.spatial and self.model <= 1:
+            raise ValueError(
+                "spatial=True repurposes the 'model' mesh axis to shard image "
+                f"height, which needs model > 1 (got model={self.model}); "
+                "with model=1 the run would silently be plain data "
+                "parallelism")
+
     def axis_sizes(self, n_devices: int) -> Tuple[int, int]:
         if self.model < 1:
             raise ValueError(f"model axis must be >= 1, got {self.model}")

@@ -57,13 +57,20 @@ def make_parallel_train(cfg: TrainConfig,
             f"use_pallas requires a single-device mesh, got {mesh.size} "
             "devices; the fused kernels target single-chip / per-shard "
             "execution (ops/pallas_kernels.py)")
-    fns = make_train_step(cfg)
+    spatial = cfg.mesh.spatial
+    img_sh = batch_sharding(mesh, 4, spatial=spatial)
+    constrain_fake = None
+    if spatial:
+        # Pin generator outputs to the real-image sharding. Without this the
+        # SPMD partitioner can leave the fake branch replicated over "model"
+        # while the real branch is height-sharded, and its shared-conv-kernel
+        # gradient comes out double-counted (~2x) — see make_train_step.
+        constrain_fake = lambda x: jax.lax.with_sharding_constraint(x, img_sh)
+    fns = make_train_step(cfg, constrain_fake=constrain_fake)
 
     state_shapes = jax.eval_shape(fns.init, jax.random.key(0))
-    spatial = cfg.mesh.spatial
     shardings = state_shardings(state_shapes, mesh, spatial=spatial)
     rep = replicated(mesh)
-    img_sh = batch_sharding(mesh, 4, spatial=spatial)
     z_sh = batch_sharding(mesh, 2)
     lbl_sh = batch_sharding(mesh, 1)
     conditional = cfg.model.num_classes > 0

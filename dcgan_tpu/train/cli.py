@@ -122,6 +122,7 @@ _FLAG_FIELDS = {
     "df_dim": ("model", "df_dim"), "num_classes": ("model", "num_classes"),
     "use_pallas": ("model", "use_pallas"),
     "mesh_data": ("mesh", "data"), "mesh_model": ("mesh", "model"),
+    "mesh_spatial": ("mesh", "spatial"),
 }
 
 

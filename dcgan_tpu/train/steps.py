@@ -84,12 +84,24 @@ class TrainStepFns:
                           # activation histogram/sparsity stats (on device)
 
 
-def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None
+def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
+                    constrain_fake: Optional[Callable] = None
                     ) -> TrainStepFns:
+    """constrain_fake, if given, is applied to every generator output that is
+    fed to the discriminator during training. The parallel layer passes a
+    `with_sharding_constraint` to the real-image sharding here when the mesh
+    spatially shards images: without it GSPMD is free to leave the fake branch
+    replicated over the "model" axis while the real branch is height-sharded,
+    and the partitioner then DOUBLE-COUNTS the fake branch's contribution to
+    the shared conv-kernel gradients (observed ~2x grads on the 8-device CPU
+    mesh; the constraint restores f64-level agreement — see
+    tests/test_parallel.py::test_sharded_step_matches_single_device[dp4xsp2]).
+    """
     mcfg = cfg.model
     opt = make_optimizer(cfg)
     wgan = cfg.loss == "wgan-gp"
     gan_losses = L.wgan_losses if wgan else L.bce_gan_losses
+    _cf = constrain_fake if constrain_fake is not None else (lambda x: x)
 
     def _pmean(x):
         return lax.pmean(x, axis_name) if axis_name is not None else x
@@ -99,6 +111,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None
                   labels) -> Tuple[jax.Array, Tuple]:
         fake, _ = generator_apply(g_params, bn["gen"], z, cfg=mcfg, train=True,
                                   labels=labels, axis_name=axis_name)
+        fake = _cf(fake)
         # D sees real then fake, chaining BN state through both applications —
         # the functional analogue of the reference's two discriminator() calls
         # with reuse=True (image_train.py:82,85).
@@ -129,6 +142,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None
         fake, g_bn = generator_apply(g_params, bn["gen"], z, cfg=mcfg,
                                      train=True, labels=labels,
                                      axis_name=axis_name)
+        fake = _cf(fake)
         _, fake_logits, _ = discriminator_apply(
             d_params, bn["disc"], fake, cfg=mcfg, train=True, labels=labels,
             axis_name=axis_name)

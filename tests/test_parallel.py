@@ -64,8 +64,6 @@ def test_sharding_rules():
 def test_spatial_sharding_rules():
     """spatial=True: weights replicate; images shard over (batch, height) —
     the sequence-parallel analogue for conv data (SURVEY.md §2.5)."""
-    from dcgan_tpu.parallel.sharding import batch_sharding
-
     cfg = TrainConfig(model=TINY, batch_size=16,
                       mesh=MeshConfig(model=2, spatial=True))
     mesh = make_mesh(cfg.mesh)

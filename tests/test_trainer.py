@@ -55,6 +55,17 @@ class TestCLI:
         with pytest.raises(SystemExit):
             build_parser().parse_args(["--loss", "hinge"])
 
+    def test_mesh_spatial_flag_reaches_config(self):
+        args = build_parser().parse_args(["--mesh_model", "2",
+                                          "--mesh_spatial"])
+        cfg = config_from_args(args)
+        assert cfg.mesh.spatial and cfg.mesh.model == 2
+
+    def test_spatial_requires_model_axis(self):
+        from dcgan_tpu.config import MeshConfig
+        with pytest.raises(ValueError, match="model > 1"):
+            MeshConfig(spatial=True)  # model defaults to 1 — silent no-op trap
+
 
 class TestTrainLoop:
     def test_synthetic_end_to_end(self, tmp_path):
