@@ -48,9 +48,11 @@ def main() -> None:
 
     n_chips = len(jax.devices())
     cfg = TrainConfig(
-        model=ModelConfig(),       # 64x64, gf=df=64, bf16 compute
+        model=ModelConfig(          # 64x64, gf=df=64, bf16 compute
+            use_pallas=os.environ.get("BENCH_PALLAS", "") == "1"),
         batch_size=BATCH * n_chips,
-        mesh=MeshConfig())
+        mesh=MeshConfig(),
+        backend=os.environ.get("BENCH_BACKEND", "gspmd"))
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
 

@@ -169,8 +169,21 @@ class TrainConfig:
     # Misc
     seed: int = 0
     sample_size: int = 64          # fixed-z sample batch (image_train.py:43)
+    backend: str = "gspmd"         # "gspmd": jit + sharding annotations, the
+                                   # partitioner inserts collectives
+                                   # (parallel/api.py) | "shard_map": explicit
+                                   # per-device programs with hand-written
+                                   # psum/pmean (parallel/shard_map_backend.py;
+                                   # DP-only, composes with use_pallas)
 
     def __post_init__(self):
+        if self.backend not in ("gspmd", "shard_map"):
+            raise ValueError(f"unknown backend {self.backend!r}")
+        if self.backend == "shard_map" and (self.mesh.model != 1
+                                            or self.mesh.spatial):
+            raise ValueError(
+                "backend='shard_map' is data-parallel only (mesh.model must "
+                f"be 1, spatial False); got mesh={self.mesh}")
         if self.loss not in ("gan", "wgan-gp"):
             raise ValueError(f"unknown loss {self.loss!r}")
         if self.update_mode not in ("sequential", "fused"):

@@ -17,6 +17,7 @@ from dcgan_tpu.parallel.sharding import (  # noqa: F401
     state_shardings,
 )
 from dcgan_tpu.parallel.api import ParallelTrain, make_parallel_train  # noqa: F401
+from dcgan_tpu.parallel.shard_map_backend import make_shard_map_train  # noqa: F401
 from dcgan_tpu.parallel.distributed import (  # noqa: F401
     initialize_multihost,
     is_chief,

@@ -48,15 +48,20 @@ class ParallelTrain:
 
 def make_parallel_train(cfg: TrainConfig,
                         mesh: Optional[Mesh] = None) -> ParallelTrain:
+    if cfg.backend == "shard_map":
+        from dcgan_tpu.parallel.shard_map_backend import make_shard_map_train
+
+        return make_shard_map_train(cfg, mesh)
     mesh = mesh or make_mesh(cfg.mesh)
     if cfg.model.use_pallas and mesh.size > 1:
         # pallas_call is opaque to GSPMD: under a sharded mesh XLA would
         # replicate activations around every BN instead of partitioning —
         # silent collapse of data parallelism. Reject rather than degrade.
         raise ValueError(
-            f"use_pallas requires a single-device mesh, got {mesh.size} "
-            "devices; the fused kernels target single-chip / per-shard "
-            "execution (ops/pallas_kernels.py)")
+            f"use_pallas requires a single-device mesh under the gspmd "
+            f"backend, got {mesh.size} devices; use backend='shard_map', "
+            "where the fused kernels run per-shard with explicit collectives "
+            "(parallel/shard_map_backend.py)")
     spatial = cfg.mesh.spatial
     img_sh = batch_sharding(mesh, 4, spatial=spatial)
     constrain_fake = None

@@ -87,6 +87,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="data-parallel axis size (-1 = all devices)")
     p.add_argument("--mesh_model", type=int, default=1,
                    help="tensor-parallel axis size")
+    p.add_argument("--backend", choices=["gspmd", "shard_map"],
+                   default="gspmd",
+                   help="collective strategy: gspmd = jit + sharding "
+                        "annotations; shard_map = explicit per-device "
+                        "psum/pmean (DP-only, composes with --use_pallas)")
     p.add_argument("--mesh_spatial", action="store_true",
                    help="use the model axis to shard image height instead of "
                         "weights (conv halo exchange; the sequence-parallel "
@@ -122,7 +127,7 @@ _FLAG_FIELDS = {
     "df_dim": ("model", "df_dim"), "num_classes": ("model", "num_classes"),
     "use_pallas": ("model", "use_pallas"),
     "mesh_data": ("mesh", "data"), "mesh_model": ("mesh", "model"),
-    "mesh_spatial": ("mesh", "spatial"),
+    "mesh_spatial": ("mesh", "spatial"), "backend": ("", "backend"),
 }
 
 

@@ -269,7 +269,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                             capture=d_cap)
         acts = {**{f"gen/{k}": v for k, v in g_cap.items()},
                 **{f"disc/{k}": v for k, v in d_cap.items()}}
-        return activation_stats(acts)
+        return activation_stats(acts, axis_name=axis_name)
 
     def init(key):
         return init_train_state(key, cfg)

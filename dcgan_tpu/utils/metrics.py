@@ -38,7 +38,8 @@ def histogram_summary(values, bins: int = 30) -> Dict[str, Any]:
     }
 
 
-def activation_stats(acts: Mapping[str, Any], bins: int = 30
+def activation_stats(acts: Mapping[str, Any], bins: int = 30,
+                     axis_name: Optional[str] = None
                      ) -> Dict[str, Dict[str, Any]]:
     """Device-side histogram + sparsity per activation tensor.
 
@@ -47,22 +48,44 @@ def activation_stats(acts: Mapping[str, Any], bins: int = 30
     the jitted summary program — only ~2*bins scalars per layer cross to the
     host. Returns {name: {min,max,mean,std,zero_fraction,bin_counts,bin_edges}}
     of jnp values; MetricWriter.write_activations converts to JSON.
+
+    With `axis_name` (explicit-collective execution, e.g. the shard_map
+    backend) the stats are *global*: min/max are pmax'd first so every shard
+    bins against the same edges, then the counts psum — the result is the
+    exact histogram of the full cross-shard batch, identical on every shard.
     """
     import jax.numpy as jnp
+    from jax import lax
 
     out: Dict[str, Dict[str, Any]] = {}
     for name, x in acts.items():
         v = x.astype(jnp.float32).ravel()
-        counts, edges = jnp.histogram(v, bins=bins)
+        lo, hi = jnp.min(v), jnp.max(v)
+        mean = jnp.mean(v)
+        mean_sq = jnp.mean(v * v)
+        zero_frac = jnp.mean(v == 0.0)
+        count = v.size
+        if axis_name is not None:
+            lo = lax.pmin(lo, axis_name)
+            hi = lax.pmax(hi, axis_name)
+            mean = lax.pmean(mean, axis_name)
+            mean_sq = lax.pmean(mean_sq, axis_name)
+            zero_frac = lax.pmean(zero_frac, axis_name)
+            count = count * lax.psum(1, axis_name)
+        counts, edges = jnp.histogram(v, bins=bins, range=(lo, hi))
+        if axis_name is not None:
+            counts = lax.psum(counts, axis_name)
         out[name] = {
-            "count": v.size,
-            "min": jnp.min(v),
-            "max": jnp.max(v),
-            "mean": jnp.mean(v),
-            "std": jnp.std(v),
+            "count": count,
+            "min": lo,
+            "max": hi,
+            "mean": mean,
+            # global std from pmean'd moments (pmean of local stds would not
+            # be the std of the full batch)
+            "std": jnp.sqrt(jnp.maximum(mean_sq - mean * mean, 0.0)),
             # the reference's per-layer sparsity scalar
             # (tf.nn.zero_fraction, distriubted_model.py:80)
-            "zero_fraction": jnp.mean(v == 0.0),
+            "zero_fraction": zero_frac,
             "bin_counts": counts,
             "bin_edges": edges,
         }
