@@ -144,6 +144,10 @@ class TrainConfig:
     # Observability (image_train.py:37,129,179)
     checkpoint_dir: str = "checkpoint"
     sample_dir: str = "samples"
+    tensorboard: bool = True       # mirror metrics into TensorBoard-native
+                                   # event files (utils/tb_events.py) next to
+                                   # the JSONL stream — the reference's
+                                   # summary-file channel (image_train.py:118)
     save_summaries_secs: float = 10.0
     save_model_secs: float = 600.0   # single-process checkpoint cadence
     save_model_steps: int = 1000     # multi-host cadence (collective save

@@ -36,6 +36,7 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -87,7 +88,20 @@ def make_shard_map_train(cfg: TrainConfig,
         return fns.train_step(state, images, key, labels)
 
     def sample_body(state, z, labels=None):
-        return fns.sample(state, z, labels)
+        # Gather the shard outputs so sample() returns a replicated array —
+        # the ParallelTrain contract ("replicated output for host saving"):
+        # on multi-host runs a data-sharded result would not be fully
+        # addressable and the trainer's device_get of the grid would fail.
+        # Expressed as scatter-into-zeros + psum rather than all_gather
+        # because psum's output is statically replicated for the VMA checker
+        # (all_gather results are formally still device-varying).
+        imgs = fns.sample(state, z, labels)
+        per_shard = imgs.shape[0]
+        full = jnp.zeros((per_shard * n_shards,) + imgs.shape[1:],
+                         imgs.dtype)
+        full = lax.dynamic_update_slice_in_dim(
+            full, imgs, lax.axis_index(DATA_AXIS) * per_shard, axis=0)
+        return lax.psum(full, DATA_AXIS)
 
     def summarize_body(state, images, key, labels=None):
         # fold like step_body: each shard's generator activations come from
@@ -97,13 +111,12 @@ def make_shard_map_train(cfg: TrainConfig,
         key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
         return fns.summarize(state, images, key, labels)
 
-    img_out_spec = P(DATA_AXIS, None, None, None)
     if conditional:
         step = jax.jit(
             smap(step_body, (P(), img_spec, P(), lbl_spec), (P(), P())),
             donate_argnums=(0,))
         sample = jax.jit(
-            smap(sample_body, (P(), z_spec, lbl_spec), img_out_spec))
+            smap(sample_body, (P(), z_spec, lbl_spec), P()))
         # summarize: activation_stats pmaxes min/max before binning and psums
         # the counts (utils/metrics.py), so the per-shard programs emit
         # identical global histograms — replicated outputs.
@@ -114,7 +127,7 @@ def make_shard_map_train(cfg: TrainConfig,
             smap(step_body, (P(), img_spec, P()), (P(), P())),
             donate_argnums=(0,))
         sample = jax.jit(
-            smap(sample_body, (P(), z_spec), img_out_spec))
+            smap(sample_body, (P(), z_spec), P()))
         summarize = jax.jit(
             smap(summarize_body, (P(), img_spec, P()), P()))
 

@@ -69,6 +69,9 @@ def build_parser() -> argparse.ArgumentParser:
     # observability / checkpoint (image_train.py:20-21,37,129)
     p.add_argument("--checkpoint_dir", default="checkpoint")
     p.add_argument("--sample_dir", default="samples")
+    p.add_argument("--no_tensorboard", action="store_true",
+                   help="disable the TensorBoard event-file mirror "
+                        "(JSONL metrics are always written)")
     p.add_argument("--save_summaries_secs", type=float, default=10.0)
     p.add_argument("--save_model_secs", type=float, default=600.0)
     p.add_argument("--sample_every_steps", type=int, default=100)
@@ -150,6 +153,9 @@ def apply_overrides(cfg: TrainConfig, given: argparse.Namespace) -> TrainConfig:
     for flag, value in vars(given).items():
         if flag == "no_normalize":
             top["normalize_inputs"] = not value
+            continue
+        if flag == "no_tensorboard":
+            top["tensorboard"] = not value
             continue
         if flag not in _FLAG_FIELDS:
             continue  # preset / synthetic / platform — not config fields

@@ -88,7 +88,8 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
                         save_interval_steps=cfg.save_model_steps)
     writer = MetricWriter(cfg.checkpoint_dir,
                           every_secs=cfg.save_summaries_secs,
-                          enabled=chief)
+                          enabled=chief,
+                          tensorboard=cfg.tensorboard)
 
     state = pt.init(jax.random.key(cfg.seed))
     restored = ckpt.restore_latest(state)
@@ -185,6 +186,7 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
         ckpt.maybe_save(new_step, state)
 
     trace.close()
+    writer.close()
     ckpt.save(total_steps, state, force=True)
     ckpt.wait()
     return state

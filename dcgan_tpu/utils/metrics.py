@@ -62,16 +62,19 @@ def activation_stats(acts: Mapping[str, Any], bins: int = 30,
         v = x.astype(jnp.float32).ravel()
         lo, hi = jnp.min(v), jnp.max(v)
         mean = jnp.mean(v)
-        mean_sq = jnp.mean(v * v)
         zero_frac = jnp.mean(v == 0.0)
         count = v.size
         if axis_name is not None:
             lo = lax.pmin(lo, axis_name)
             hi = lax.pmax(hi, axis_name)
             mean = lax.pmean(mean, axis_name)
-            mean_sq = lax.pmean(mean_sq, axis_name)
             zero_frac = lax.pmean(zero_frac, axis_name)
             count = count * lax.psum(1, axis_name)
+        # two-pass variance around the (global) mean — E[x^2]-E[x]^2 would
+        # cancel catastrophically in f32 for low-relative-variance layers
+        var = jnp.mean(jnp.square(v - mean))
+        if axis_name is not None:
+            var = lax.pmean(var, axis_name)
         counts, edges = jnp.histogram(v, bins=bins, range=(lo, hi))
         if axis_name is not None:
             counts = lax.psum(counts, axis_name)
@@ -80,9 +83,7 @@ def activation_stats(acts: Mapping[str, Any], bins: int = 30,
             "min": lo,
             "max": hi,
             "mean": mean,
-            # global std from pmean'd moments (pmean of local stds would not
-            # be the std of the full batch)
-            "std": jnp.sqrt(jnp.maximum(mean_sq - mean * mean, 0.0)),
+            "std": jnp.sqrt(var),
             # the reference's per-layer sparsity scalar
             # (tf.nn.zero_fraction, distriubted_model.py:80)
             "zero_fraction": zero_frac,
@@ -98,17 +99,29 @@ class MetricWriter:
     write_scalars / write_histograms append JSONL events; `every_secs`
     mirrors the reference's save_summaries_secs gate (image_train.py:37,
     155-178): ready() flips true at most once per interval.
+
+    With tensorboard=True (default) every event is mirrored into a
+    TensorBoard-native events.out.tfevents.* file (utils/tb_events.py) —
+    scalars, per-layer histograms + sparsity scalars, and sample-grid images
+    render in the same dashboards the reference's TF summaries did
+    (image_train.py:86-118).
     """
 
     def __init__(self, logdir: str, *, every_secs: float = 10.0,
-                 enabled: bool = True, filename: str = "events.jsonl"):
+                 enabled: bool = True, filename: str = "events.jsonl",
+                 tensorboard: bool = True):
         self.logdir = logdir
         self.every_secs = every_secs
         self.enabled = enabled
         self._next_time = 0.0  # first call always fires, like the reference
         self._path = os.path.join(logdir, filename)
+        self._tb = None
         if enabled:
             os.makedirs(logdir, exist_ok=True)
+            if tensorboard:
+                from dcgan_tpu.utils.tb_events import TBEventWriter
+
+                self._tb = TBEventWriter(logdir)
 
     def ready(self, now: Optional[float] = None) -> bool:
         if not self.enabled:
@@ -130,14 +143,26 @@ class MetricWriter:
             f.write(json.dumps(event) + "\n")
 
     def write_scalars(self, step: int, scalars: Mapping[str, Any]) -> None:
-        self._emit("scalars", step,
-                   {"values": {k: float(v) for k, v in scalars.items()}})
+        vals = {k: float(v) for k, v in scalars.items()}
+        self._emit("scalars", step, {"values": vals})
+        if self._tb:
+            for k, v in vals.items():
+                self._tb.add_scalar(k, v, step)
+            self._tb.flush()
 
     def write_histograms(self, step: int, tensors: Mapping[str, Any],
                          bins: int = 30) -> None:
-        self._emit("histograms", step,
-                   {"values": {k: histogram_summary(v, bins)
-                               for k, v in tensors.items()}})
+        # one reduction pass per tensor; the TB mirror reuses the bins
+        summaries = {k: histogram_summary(v, bins) for k, v in tensors.items()}
+        self._emit("histograms", step, {"values": summaries})
+        if self._tb:
+            for k, s in summaries.items():
+                self._tb.add_histogram_bins(
+                    k, step, bin_edges=s["bin_edges"],
+                    bin_counts=s["bin_counts"], minimum=s["min"],
+                    maximum=s["max"], num=float(s["count"]), mean=s["mean"],
+                    std=s["std"])
+            self._tb.flush()
 
     def write_activations(self, step: int,
                           stats: Mapping[str, Mapping[str, Any]]) -> None:
@@ -153,13 +178,35 @@ class MetricWriter:
                 else:
                     out[k] = int(a) if k == "count" else float(a)
             return out
-        self._emit("activations", step,
-                   {"values": {k: conv(rec) for k, rec in stats.items()}})
+        converted = {k: conv(rec) for k, rec in stats.items()}
+        self._emit("activations", step, {"values": converted})
+        if self._tb:
+            # the reference's two per-layer channels: activation histogram +
+            # sparsity scalar (distriubted_model.py:79-80)
+            for k, rec in converted.items():
+                self._tb.add_histogram_bins(
+                    k + "/activations", step,
+                    bin_edges=rec["bin_edges"], bin_counts=rec["bin_counts"],
+                    minimum=rec["min"], maximum=rec["max"],
+                    num=float(rec["count"]), mean=rec["mean"],
+                    std=rec["std"])
+                self._tb.add_scalar(k + "/sparsity", rec["zero_fraction"],
+                                    step)
+            self._tb.flush()
 
     def write_image_event(self, step: int, name: str, path: str) -> None:
         """Record that an image artifact was written (the grid PNG itself is
         saved by utils.images)."""
         self._emit("image", step, {"name": name, "path": path})
+        if self._tb and os.path.exists(path):
+            with open(path, "rb") as f:
+                self._tb.add_image_png(name, f.read(), step)
+            self._tb.flush()
+
+    def close(self) -> None:
+        if self._tb:
+            self._tb.close()
+            self._tb = None
 
 
 def param_histograms(params, prefix: str = "") -> Dict[str, np.ndarray]:
