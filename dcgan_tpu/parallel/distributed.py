@@ -29,6 +29,8 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
         "JAX_COORDINATOR_ADDRESS")
     if coordinator_address is None and num_processes is None:
         return  # single-process
+    if jax.process_count() > 1:
+        return  # already initialized (e.g. by the launching harness)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -1,0 +1,60 @@
+"""Subprocess body for the two-process multi-host test (test_multihost.py).
+
+Each process owns 4 virtual CPU devices; jax.distributed.initialize forms the
+2-process job over localhost gRPC — the DCN path that replaces the reference's
+ClusterSpec/Server bring-up (image_train.py:52-63). Runs the real trainer
+(synthetic data) for a few steps: sharded SPMD step over the 8-device global
+mesh, chief-gated metrics + sample grid, collective final checkpoint.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+
+def main() -> None:
+    coord = os.environ["MH_COORD"]
+    nproc = int(os.environ["MH_NPROC"])
+    pid = int(os.environ["MH_PID"])
+    workdir = os.environ["MH_DIR"]
+    backend = os.environ.get("MH_BACKEND", "gspmd")
+
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+    assert jax.device_count() == 4 * nproc, jax.device_count()
+
+    from dcgan_tpu.config import ModelConfig, TrainConfig
+    from dcgan_tpu.train.trainer import train
+
+    cfg = TrainConfig(
+        model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                          compute_dtype="float32"),
+        batch_size=16,                       # global; 8 per process
+        backend=backend,
+        checkpoint_dir=os.path.join(workdir, "ckpt"),
+        sample_dir=os.path.join(workdir, "samples"),
+        sample_every_steps=3,                # exercises replicated sample()
+        activation_summary_steps=2,          # exercises the summarize program
+        save_model_steps=10_000,             # periodic off; final save only
+        log_every_steps=1,
+        sample_size=16,
+        sample_grid=(4, 4))
+    state = train(cfg, synthetic_data=True, max_steps=4)
+    step = int(jax.device_get(state["step"]))
+    print(f"MH_OK pid={jax.process_index()} step={step}", flush=True)
+    assert step == 4
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

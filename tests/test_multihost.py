@@ -1,0 +1,78 @@
+"""True multi-process distributed training over localhost DCN.
+
+The reference's multi-node story was hand-launched ps/worker processes over
+gRPC (SURVEY.md §2.5). Here two real OS processes, each owning 4 virtual CPU
+devices, form one 8-device job via jax.distributed.initialize and run the
+actual trainer: sharded step over the global mesh, process-0-only metrics and
+sample grids, collective Orbax checkpoint at exit. This is the closest a
+single machine gets to exercising the multi-host path for real — everything
+(coordination service, cross-process GSPMD, make_array_from_process_local_data,
+chief gating, collective save) is the code multi-host TPU runs.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_job(tmp_path, backend: str) -> None:
+    port = _free_port()
+    procs = []
+    for pid in range(2):
+        env = dict(os.environ)
+        env.pop("JAX_COORDINATOR_ADDRESS", None)
+        env.update({
+            "MH_COORD": f"127.0.0.1:{port}",
+            "MH_NPROC": "2",
+            "MH_PID": str(pid),
+            "MH_DIR": str(tmp_path),
+            "MH_BACKEND": backend,
+            "PYTHONPATH": _REPO,
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+    assert "MH_OK pid=0 step=4" in outs[0], outs[0][-2000:]
+    assert "MH_OK pid=1 step=4" in outs[1], outs[1][-2000:]
+
+    # chief-only observability artifacts
+    ckpt_dir = tmp_path / "ckpt"
+    assert (ckpt_dir / "events.jsonl").exists()
+    assert [f for f in os.listdir(ckpt_dir) if "tfevents" in f]
+    assert any(f.endswith(".png") for f in os.listdir(tmp_path / "samples"))
+    # collective final checkpoint at step 4 restorable-on-disk
+    assert (ckpt_dir / "4").exists()
+
+
+def test_two_process_gspmd(tmp_path):
+    _run_job(tmp_path, "gspmd")
+
+
+@pytest.mark.skipif(os.environ.get("DCGAN_TPU_FULL_MH") != "1",
+                    reason="second 2-process compile round is slow; set "
+                           "DCGAN_TPU_FULL_MH=1 to run the shard_map job too")
+def test_two_process_shard_map(tmp_path):
+    _run_job(tmp_path, "shard_map")
