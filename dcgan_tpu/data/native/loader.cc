@@ -18,6 +18,9 @@
 // Build: g++ -std=c++17 -O3 -shared -fPIC (see native.py); zero dependencies.
 
 #include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -241,6 +244,14 @@ class Loader {
   explicit Loader(LoaderConfig cfg) : cfg_(std::move(cfg)), rng_(cfg_.seed) {
     capacity_ = size_t(cfg_.min_after_dequeue) + 3 * size_t(cfg_.batch);
     int n = std::max(1, std::min<int>(cfg_.n_threads, int(cfg_.paths.size())));
+    // n_readers_ must be written BEFORE any reader starts: the completion
+    // check below compares readers_done_ against it, and readers_.size()
+    // is NOT safe to read from the reader threads (emplace_back's size
+    // update is unsynchronized with the thread it spawns — a reader that
+    // finished a tiny shard quickly could read a stale size, never set
+    // done_, and deadlock Next() forever).
+    n_readers_ = n;
+    readers_.reserve(n);
     for (int t = 0; t < n; ++t)
       readers_.emplace_back(&Loader::ReaderLoop, this, t, n);
     batcher_ = std::thread(&Loader::BatcherLoop, this);
@@ -262,10 +273,24 @@ class Loader {
   // out_labels may be null for unlabeled configs.
   int Next(float* out, int32_t* out_labels) {
     std::unique_lock<std::mutex> lk(mu_);
-    batch_cv_.wait(lk, [&] {
-      return !batches_.empty() || (done_ && pool_.size() < size_t(cfg_.batch))
+    // End-of-data only when the pool can no longer fill a batch AND the
+    // batcher is not mid-assembly (batching_): it drains the pool under the
+    // lock but publishes to batches_ later — without the flag a consumer
+    // waking in that window would report EOF and drop the final batch.
+    while (!batch_cv_.wait_for(lk, std::chrono::seconds(5), [&] {
+      return !batches_.empty() ||
+             (done_ && !batching_ && pool_.size() < size_t(cfg_.batch))
              || !error_.empty() || stop_;
-    });
+    })) {
+      if (getenv("DCGAN_LOADER_DEBUG")) {
+        fprintf(stderr,
+                "[loader] Next waiting: batches=%zu pool=%zu done=%d "
+                "readers_done=%d/%d batching=%d stop=%d err='%s'\n",
+                batches_.size(), pool_.size(), int(done_),
+                readers_done_, n_readers_, int(batching_), int(stop_),
+                error_.c_str());
+      }
+    }
     if (!error_.empty()) return -1;
     if (batches_.empty()) return 1;
     std::vector<float> b = std::move(batches_.front());
@@ -429,7 +454,7 @@ class Loader {
     }
     // non-loop mode: signal completion when the last reader exits
     std::lock_guard<std::mutex> lk(mu_);
-    if (++readers_done_ == int(readers_.size())) {
+    if (++readers_done_ == n_readers_) {
       done_ = true;
       pool_cv_.notify_all();
       batch_cv_.notify_all();
@@ -457,6 +482,7 @@ class Loader {
           picked.push_back(std::move(pool_.back()));
           pool_.pop_back();
         }
+        batching_ = true;  // a batch is in flight until published below
       }
       space_cv_.notify_all();
       std::vector<float> batch(size_t(cfg_.batch) * ex_n);
@@ -470,6 +496,7 @@ class Loader {
         });
         if (stop_) return;
         batches_.push_back(std::move(batch));
+        batching_ = false;
       }
       batch_cv_.notify_all();
     }
@@ -486,7 +513,10 @@ class Loader {
   std::string error_;
   bool stop_ = false;
   bool done_ = false;
+  bool batching_ = false;   // batcher holds picked examples not yet published
   int readers_done_ = 0;
+  int n_readers_ = 0;       // written before threads start; readers_.size()
+                            // is not safely readable from reader threads
 
   std::vector<std::thread> readers_;
   std::thread batcher_;

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import glob
+import json
 import os
 import queue
 import random
@@ -50,14 +51,59 @@ class DataConfig:
     loop: bool = True
 
 
+# Sidecar manifest prepare.py writes next to its shards; the one filename
+# list_shards exempts from "every file is a shard".
+MANIFEST_NAME = "dataset.json"
+
+
 def list_shards(data_dir: str) -> List[str]:
     """Every regular file in data_dir is a shard, as the reference assumes
-    (image_input.py:107)."""
+    (image_input.py:107) — except the dataset.json manifest prepare.py
+    writes next to its shards."""
     paths = sorted(p for p in glob.glob(os.path.join(data_dir, "*"))
-                   if os.path.isfile(p))
+                   if os.path.isfile(p)
+                   and os.path.basename(p) != MANIFEST_NAME)
     if not paths:
         raise FileNotFoundError(f"no TFRecord shards in {data_dir}")
     return paths
+
+
+def check_manifest(data_dir: str, cfg: "DataConfig") -> None:
+    """Validate DataConfig against the dataset.json manifest, if present.
+
+    prepare.py records the knobs the records were written with; a mismatched
+    DataConfig otherwise fails deep in the loader ("example has N values,
+    expected M") or, for byte-coincidental sizes, silently misreads pixels.
+    """
+    path = os.path.join(data_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return
+    with open(path) as f:
+        manifest = json.load(f)
+    checks = [
+        ("image_size", cfg.image_size),
+        ("channels", cfg.channels),
+        ("record_dtype", cfg.record_dtype),
+        ("feature_name", cfg.feature_name),
+    ]
+    problems = [
+        f"{key}: dataset was prepared with {manifest[key]!r}, "
+        f"config says {got!r}"
+        for key, got in checks
+        if key in manifest and manifest[key] != got
+    ]
+    if cfg.label_feature and manifest.get("label_feature", "") and \
+            manifest["label_feature"] != cfg.label_feature:
+        problems.append(
+            f"label_feature: dataset has {manifest['label_feature']!r}, "
+            f"config says {cfg.label_feature!r}")
+    if cfg.label_feature and "label_feature" in manifest and \
+            not manifest["label_feature"]:
+        problems.append(
+            "config requests labels but the dataset was prepared unlabeled")
+    if problems:
+        raise ValueError(
+            f"DataConfig disagrees with {path}:\n  " + "\n  ".join(problems))
 
 
 def shard_for_process(paths: Sequence[str], process_index: int,
@@ -276,6 +322,7 @@ def make_dataset(cfg: DataConfig, sharding=None,
     """
     import jax
 
+    check_manifest(cfg.data_dir, cfg)
     paths = shard_for_process(list_shards(cfg.data_dir),
                               jax.process_index(), jax.process_count())
     loader = _make_loader(cfg, paths, cfg.seed + jax.process_index())

@@ -171,6 +171,35 @@ class TestLoaders:
         finally:
             ld.close()
 
+    def test_tiny_dataset_no_deadlock(self, tmp_path):
+        """Regression: exactly batch-many examples, one shard, loop=False.
+
+        The reader-completion check used to compare readers_done_ against
+        readers_.size(), which the spawned thread can read stale (emplace_back
+        publishes the vector size unsynchronized with the thread it starts) —
+        a reader finishing a tiny shard before the constructor returned would
+        never set done_ and Next() hung forever. 30 fresh loaders catch the
+        race reliably; each must yield its single batch then EOF."""
+        native = pytest.importorskip("dcgan_tpu.data.native")
+        paths = write_image_tfrecords(
+            str(tmp_path / "tiny"), num_examples=6, image_size=8,
+            num_shards=1, num_classes=2)
+        for trial in range(30):
+            ld = native.NativeLoader(
+                paths, batch=6, example_shape=(8, 8, 3),
+                min_after_dequeue=2, n_threads=1, seed=trial,
+                normalize=False, loop=False, label_feature="label")
+            try:
+                first = ld.next()
+                assert first is not None, f"trial {trial}: lost final batch"
+                imgs, labels = first
+                assert imgs.shape == (6, 8, 8, 3)
+                assert sorted(labels.tolist()).count(0) + \
+                    sorted(labels.tolist()).count(1) == 6
+                assert ld.next() is None  # clean EOF after the only batch
+            finally:
+                ld.close()
+
     def test_empty_feature_name_skips_non_bytes_entries(self, tmp_path):
         """feature_name='' means 'first bytes feature' — an int64 entry that
         happens to precede the image in map order must be skipped, not fail."""

@@ -1,0 +1,168 @@
+"""Dataset-preparation CLI tests: image folder -> TFRecord shards -> pipeline.
+
+Closes the loop the reference left open (its preprocessing was commented out,
+image_input.py:123-132; records were assumed to pre-exist): images written
+with PIL round-trip through prepare.convert into the exact batches the
+training pipeline yields.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dcgan_tpu.data import DataConfig, make_dataset
+from dcgan_tpu.data.prepare import build_parser, convert, load_and_preprocess
+
+
+def write_images(d, n, size=(20, 28), value=None, ext=".png"):
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        arr = (np.full(size + (3,), value, np.uint8) if value is not None
+               else rng.integers(0, 256, size + (3,), dtype=np.uint8))
+        Image.fromarray(arr).save(os.path.join(d, f"img_{i:03d}{ext}"))
+
+
+class TestPreprocess:
+    def test_center_crop_and_resize(self, tmp_path):
+        # 40x60 image, distinctive center: crop 20 keeps the middle block
+        arr = np.zeros((60, 40, 3), np.uint8)
+        arr[20:40, 10:30] = 200
+        p = str(tmp_path / "x.png")
+        Image.fromarray(arr).save(p)
+        out = load_and_preprocess(p, image_size=16, crop_size=20)
+        assert out.shape == (16, 16, 3) and out.dtype == np.float64
+        np.testing.assert_allclose(out, 200.0)  # all center pixels
+
+    def test_small_image_upscaled_before_crop(self, tmp_path):
+        p = str(tmp_path / "tiny.png")
+        Image.fromarray(np.full((8, 8, 3), 50, np.uint8)).save(p)
+        out = load_and_preprocess(p, image_size=16, crop_size=108)
+        assert out.shape == (16, 16, 3)
+        np.testing.assert_allclose(out, 50.0)
+
+    def test_crop_disabled(self, tmp_path):
+        p = str(tmp_path / "x.png")
+        Image.fromarray(np.full((10, 30, 3), 7, np.uint8)).save(p)
+        out = load_and_preprocess(p, image_size=8, crop_size=0)
+        assert out.shape == (8, 8, 3)
+
+
+class TestConvert:
+    def test_roundtrip_through_pipeline(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        write_images(src, 12, value=128)
+        paths = convert(src, dst, image_size=16, crop_size=0, num_shards=3)
+        assert len(paths) == 3
+        manifest = json.load(open(os.path.join(dst, "dataset.json")))
+        assert manifest["num_examples"] == 12
+        assert manifest["record_dtype"] == "float64"
+
+        cfg = DataConfig(data_dir=dst, image_size=16, batch_size=4,
+                         min_after_dequeue=4, n_threads=2, seed=0,
+                         normalize=True, loop=False)
+        batch = next(iter(make_dataset(cfg)))
+        assert batch.shape == (4, 16, 16, 3)
+        # 128/127.5 - 1 ~ 0.0039 after [-1,1] normalization
+        np.testing.assert_allclose(np.asarray(batch), 128 / 127.5 - 1,
+                                   atol=1e-5)
+
+    def test_uint8_records(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        write_images(src, 4, value=64)
+        convert(src, dst, image_size=8, crop_size=0, num_shards=1,
+                record_dtype="uint8")
+        cfg = DataConfig(data_dir=dst, image_size=8, batch_size=2,
+                         record_dtype="uint8", min_after_dequeue=2,
+                         n_threads=1, seed=0, normalize=True, loop=False)
+        batch = next(iter(make_dataset(cfg)))
+        np.testing.assert_allclose(np.asarray(batch), 64 / 127.5 - 1,
+                                   atol=1e-5)
+
+    def test_labeled_subdirs(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        write_images(os.path.join(src, "cat"), 3, value=10)
+        write_images(os.path.join(src, "dog"), 3, value=250)
+        convert(src, dst, image_size=8, crop_size=0, num_shards=1,
+                labeled=True)
+        manifest = json.load(open(os.path.join(dst, "dataset.json")))
+        assert manifest["classes"] == ["cat", "dog"]
+        cfg = DataConfig(data_dir=dst, image_size=8, batch_size=6,
+                         min_after_dequeue=2, n_threads=1, seed=0,
+                         normalize=False, loop=False, label_feature="label")
+        imgs, labels = next(iter(make_dataset(cfg)))
+        labels = np.asarray(labels)
+        imgs = np.asarray(imgs)
+        assert set(labels.tolist()) == {0, 1}
+        # label/image pairing survives shuffling: cat=10, dog=250
+        for img, lbl in zip(imgs, labels):
+            np.testing.assert_allclose(img, 10.0 if lbl == 0 else 250.0)
+
+    def test_refuses_stale_shards_without_overwrite(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        write_images(src, 4)
+        convert(src, dst, image_size=8, crop_size=0, num_shards=4)
+        with pytest.raises(ValueError, match="--overwrite"):
+            convert(src, dst, image_size=8, crop_size=0, num_shards=2)
+        paths = convert(src, dst, image_size=8, crop_size=0, num_shards=2,
+                        overwrite=True)
+        shards = [f for f in os.listdir(dst) if f.endswith(".tfrecord")]
+        assert len(paths) == 2 and len(shards) == 2  # no stale shard-0000[23]
+
+    def test_shards_are_class_mixed(self, tmp_path):
+        """Seeded shuffle before sharding: with 2 classes and 2 shards, each
+        shard must hold both classes (class-major order would give one each,
+        starving a 2-process run of the other class entirely)."""
+        from dcgan_tpu.data.example_proto import parse_example
+        from dcgan_tpu.data.tfrecord import read_tfrecords
+
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        write_images(os.path.join(src, "cat"), 8, value=10)
+        write_images(os.path.join(src, "dog"), 8, value=250)
+        paths = convert(src, dst, image_size=8, crop_size=0, num_shards=2,
+                        labeled=True)
+        for p in paths:
+            labels = {parse_example(r)["label"][0]
+                      for r in read_tfrecords(p)}
+            assert labels == {0, 1}, (p, labels)
+
+    def test_manifest_mismatch_rejected_by_pipeline(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        write_images(src, 4)
+        convert(src, dst, image_size=8, crop_size=0, num_shards=1,
+                record_dtype="uint8")
+        cfg = DataConfig(data_dir=dst, image_size=16, batch_size=2,
+                         record_dtype="float64", min_after_dequeue=2,
+                         n_threads=1, seed=0, loop=False)
+        with pytest.raises(ValueError, match="dataset was prepared with"):
+            next(iter(make_dataset(cfg)))
+        cfg_lbl = DataConfig(data_dir=dst, image_size=8, batch_size=2,
+                             record_dtype="uint8", min_after_dequeue=2,
+                             n_threads=1, seed=0, loop=False,
+                             label_feature="label")
+        with pytest.raises(ValueError, match="prepared unlabeled"):
+            next(iter(make_dataset(cfg_lbl)))
+
+    def test_empty_dir_rejected(self, tmp_path):
+        src = str(tmp_path / "empty")
+        os.makedirs(src)
+        with pytest.raises(ValueError, match="no images"):
+            convert(src, str(tmp_path / "out"))
+
+    def test_labeled_without_subdirs_rejected(self, tmp_path):
+        src = str(tmp_path / "flat")
+        write_images(src, 2)
+        with pytest.raises(ValueError, match="subdirectories"):
+            convert(src, str(tmp_path / "out"), labeled=True)
+
+
+def test_cli_parser():
+    args = build_parser().parse_args(
+        ["--input_dir", "a", "--output_dir", "b", "--record_dtype", "uint8",
+         "--labeled", "--crop_size", "0"])
+    assert args.record_dtype == "uint8" and args.labeled
+    with pytest.raises(SystemExit):
+        build_parser().parse_args(["--input_dir", "a"])  # output_dir required
