@@ -127,6 +127,11 @@ class TrainConfig:
                                      # semantics); "fused": both grads from the same
                                      # params, applied together (reference parity,
                                      # SURVEY.md §2.4 #2, image_train.py:156-158)
+    g_ema_decay: float = 0.0       # >0 keeps an EMA copy of generator weights
+                                   # updated per step and samples from it —
+                                   # a beyond-reference FID improvement
+                                   # (typical 0.999); 0 = off (strict parity:
+                                   # the reference samples live weights)
 
     # Data (image_input.py:11-16, image_train.py:19-26)
     data_dir: str = "train"
@@ -194,6 +199,9 @@ class TrainConfig:
             raise ValueError(f"unknown update_mode {self.update_mode!r}")
         if self.n_critic < 1:
             raise ValueError(f"n_critic must be >= 1, got {self.n_critic}")
+        if not 0.0 <= self.g_ema_decay < 1.0:
+            raise ValueError(
+                f"g_ema_decay must be in [0, 1), got {self.g_ema_decay}")
         if self.n_critic > 1 and self.update_mode == "fused":
             raise ValueError(
                 "update_mode='fused' (reference-parity single fused step) is "

@@ -90,6 +90,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="data-parallel axis size (-1 = all devices)")
     p.add_argument("--mesh_model", type=int, default=1,
                    help="tensor-parallel axis size")
+    p.add_argument("--g_ema_decay", type=float, default=0.0,
+                   help="EMA decay for a shadow copy of generator weights "
+                        "used for sampling (0 = off, reference parity; "
+                        "typical 0.999)")
     p.add_argument("--backend", choices=["gspmd", "shard_map"],
                    default="gspmd",
                    help="collective strategy: gspmd = jit + sharding "
@@ -112,6 +116,7 @@ _FLAG_FIELDS = {
     "batch_size": ("", "batch_size"), "max_steps": ("", "max_steps"),
     "loss": ("", "loss"), "update_mode": ("", "update_mode"),
     "n_critic": ("", "n_critic"), "gp_weight": ("", "gp_weight"),
+    "g_ema_decay": ("", "g_ema_decay"),
     "dataset": ("", "dataset"), "data_dir": ("", "data_dir"),
     "sample_image_dir": ("", "sample_image_dir"),
     "record_dtype": ("", "record_dtype"),

@@ -59,10 +59,16 @@ def init_train_state(key, cfg: TrainConfig) -> Pytree:
     """Build the full training state pytree.
 
     The checkpointed logical set matches the reference's Saver contents
-    (SURVEY.md §5: G/D weights, BN β/γ + running stats, Adam moments, step).
+    (SURVEY.md §5: G/D weights, BN β/γ + running stats, Adam moments, step),
+    plus an EMA copy of the generator weights.
     """
     params, bn = gan_init(key, cfg.model)
     opt = make_optimizer(cfg)
+    # ema_gen is ALWAYS part of the state so the checkpoint tree structure is
+    # independent of cfg.g_ema_decay — a checkpoint trained with EMA on
+    # restores under an eval/generate/resume config with it off (and vice
+    # versa). With decay=0 it is just a live mirror (one G-param-tree write
+    # per step, negligible next to the step's compute).
     return {
         "params": params,
         "bn": bn,
@@ -70,6 +76,7 @@ def init_train_state(key, cfg: TrainConfig) -> Pytree:
             "gen": opt.init(params["gen"]),
             "disc": opt.init(params["disc"]),
         },
+        "ema_gen": jax.tree_util.tree_map(jnp.copy, params["gen"]),
         "step": jnp.zeros((), jnp.int32),
     }
 
@@ -230,6 +237,10 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             # §2.4 #3), this counts full D+G steps.
             "step": state["step"] + 1,
         }
+        d_ema = cfg.g_ema_decay  # 0 -> ema_gen mirrors the live weights
+        new_state["ema_gen"] = jax.tree_util.tree_map(
+            lambda e, p: d_ema * e + (1.0 - d_ema) * p,
+            state["ema_gen"], new_gen)
         metrics = {
             "d_loss": _pmean(d_loss),
             "d_loss_real": _pmean(d_real),
@@ -242,7 +253,14 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
 
     def sample(state: Pytree, z: jax.Array,
                labels: Optional[jax.Array] = None) -> jax.Array:
-        return sampler_apply(state["params"]["gen"], state["bn"]["gen"], z,
+        # EMA weights when tracking is on (g_ema_decay > 0); the reference
+        # samples live weights (image_train.py:181-184), which remains the
+        # default. Selected by config, not key presence — ema_gen always
+        # exists in the state (see init_train_state) but under decay=0 it is
+        # a by-construction mirror and live weights are the clearer choice.
+        g_params = (state["ema_gen"] if cfg.g_ema_decay > 0.0
+                    else state["params"]["gen"])
+        return sampler_apply(g_params, state["bn"]["gen"], z,
                              cfg=mcfg, labels=labels)
 
     def summarize(state: Pytree, images: jax.Array, key: jax.Array,

@@ -145,6 +145,25 @@ def test_conditional_sharded_step():
     assert np.isfinite(float(m["d_loss"]))
 
 
+def test_g_ema_sharded():
+    """ema_gen mirrors the generator param paths, so the TP sharding rules
+    hit it automatically; one sharded step keeps it consistent."""
+    cfg = TrainConfig(model=TINY, batch_size=16, g_ema_decay=0.999,
+                      mesh=MeshConfig(model=2))
+    mesh = make_mesh(cfg.mesh)
+    fns = make_train_step(cfg)
+    shapes = jax.eval_shape(fns.init, jax.random.key(0))
+    sh = state_shardings(shapes, mesh)
+    assert sh["ema_gen"]["proj"]["w"].spec == P(None, "model")
+
+    pt = make_parallel_train(cfg, mesh)
+    s = pt.init(jax.random.key(0))
+    s, m = pt.step(s, real_batch(), jax.random.key(1))
+    assert np.isfinite(float(m["g_loss"]))
+    z = jax.random.uniform(jax.random.key(2), (16, 100), minval=-1, maxval=1)
+    assert pt.sample(s, z).shape == (16, 16, 16, 3)
+
+
 def test_wgan_gp_sharded():
     """Grad-of-grad through the GSPMD-sharded mesh (SURVEY.md §7 hard part c)."""
     cfg = TrainConfig(model=TINY, batch_size=16, loss="wgan-gp")
