@@ -44,6 +44,8 @@ class ParallelTrain:
     step: Callable
     sample: Callable
     summarize: Callable  # (state, images, key[, labels]) -> activation stats
+    eval_losses: Callable  # (state, images, z[, labels]) -> loss metrics
+                           # on a held-out batch, no state update
 
 
 def make_parallel_train(cfg: TrainConfig,
@@ -96,6 +98,10 @@ def make_parallel_train(cfg: TrainConfig,
             fns.summarize,
             in_shardings=(shardings, img_sh, rep, lbl_sh),
             out_shardings=rep)
+        eval_losses = jax.jit(
+            fns.eval_losses,
+            in_shardings=(shardings, img_sh, z_sh, lbl_sh),
+            out_shardings=rep)
     else:
         step = jax.jit(
             fns.train_step,
@@ -110,7 +116,11 @@ def make_parallel_train(cfg: TrainConfig,
             fns.summarize,
             in_shardings=(shardings, img_sh, rep),
             out_shardings=rep)
+        eval_losses = jax.jit(
+            fns.eval_losses,
+            in_shardings=(shardings, img_sh, z_sh),
+            out_shardings=rep)
 
     return ParallelTrain(mesh=mesh, cfg=cfg, shardings=shardings,
                          init=init, step=step, sample=sample,
-                         summarize=summarize)
+                         summarize=summarize, eval_losses=eval_losses)

@@ -122,6 +122,9 @@ def make_shard_map_train(cfg: TrainConfig,
         # identical global histograms — replicated outputs.
         summarize = jax.jit(
             smap(summarize_body, (P(), img_spec, P(), lbl_spec), P()))
+        # eval_losses: per-shard losses pmean'd inside -> replicated metrics
+        eval_losses = jax.jit(
+            smap(fns.eval_losses, (P(), img_spec, z_spec, lbl_spec), P()))
     else:
         step = jax.jit(
             smap(step_body, (P(), img_spec, P()), (P(), P())),
@@ -130,6 +133,8 @@ def make_shard_map_train(cfg: TrainConfig,
             smap(sample_body, (P(), z_spec), P()))
         summarize = jax.jit(
             smap(summarize_body, (P(), img_spec, P()), P()))
+        eval_losses = jax.jit(
+            smap(fns.eval_losses, (P(), img_spec, z_spec), P()))
 
     init = jax.jit(fns.init, out_shardings=rep)
 
@@ -137,4 +142,4 @@ def make_shard_map_train(cfg: TrainConfig,
         lambda _: rep, jax.eval_shape(fns.init, jax.random.key(0)))
     return ParallelTrain(mesh=mesh, cfg=cfg, shardings=shardings,
                          init=init, step=step, sample=sample,
-                         summarize=summarize)
+                         summarize=summarize, eval_losses=eval_losses)

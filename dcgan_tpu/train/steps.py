@@ -89,6 +89,9 @@ class TrainStepFns:
     init: Callable        # (key,) -> state
     summarize: Callable   # (state, images, key[, labels]) -> per-layer
                           # activation histogram/sparsity stats (on device)
+    eval_losses: Callable  # (state, images, z[, labels]) -> loss metrics,
+                           # no state update — the reference's sample-batch
+                           # loss probe (image_train.py:179-192)
 
 
 def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
@@ -112,6 +115,19 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
 
     def _pmean(x):
         return lax.pmean(x, axis_name) if axis_name is not None else x
+
+    def _loss_metrics(d_loss, d_real, d_fake, g_loss, gp) -> dict:
+        # one assembly for train_step and eval_losses so the sample/* probe
+        # can never silently diverge from the training metrics
+        metrics = {
+            "d_loss": _pmean(d_loss),
+            "d_loss_real": _pmean(d_real),
+            "d_loss_fake": _pmean(d_fake),
+            "g_loss": _pmean(g_loss),
+        }
+        if wgan:
+            metrics["gp"] = _pmean(gp)
+        return metrics
 
     def d_loss_fn(d_params: Pytree, g_params: Pytree, bn: Pytree,
                   images: jax.Array, z: jax.Array, gp_key,
@@ -241,15 +257,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         new_state["ema_gen"] = jax.tree_util.tree_map(
             lambda e, p: d_ema * e + (1.0 - d_ema) * p,
             state["ema_gen"], new_gen)
-        metrics = {
-            "d_loss": _pmean(d_loss),
-            "d_loss_real": _pmean(d_real),
-            "d_loss_fake": _pmean(d_fake),
-            "g_loss": _pmean(g_loss),
-        }
-        if wgan:
-            metrics["gp"] = _pmean(gp)
-        return new_state, metrics
+        return new_state, _loss_metrics(d_loss, d_real, d_fake, g_loss, gp)
 
     def sample(state: Pytree, z: jax.Array,
                labels: Optional[jax.Array] = None) -> jax.Array:
@@ -289,8 +297,25 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                 **{f"disc/{k}": v for k, v in d_cap.items()}}
         return activation_stats(acts, axis_name=axis_name)
 
+    def eval_losses(state: Pytree, images: jax.Array, z: jax.Array,
+                    labels: Optional[jax.Array] = None) -> dict:
+        """Loss probe on a held-out batch with a caller-fixed z, no update —
+        the reference's every-100-steps sample evaluation: it feeds the
+        *sample* pipeline's batch and the fixed sample_z through the train
+        graph's loss tensors without running the optimizers
+        (image_train.py:179-192). Train-mode BN (batch statistics), matching
+        the reference's reuse of the train graph; the returned BN state is
+        discarded. WGAN-GP's interpolation uses a fixed key: a deterministic
+        probe, not a training signal."""
+        params, bn = state["params"], state["bn"]
+        gp_key = jax.random.key(0)
+        d_loss, (_, d_real, d_fake, gp) = d_loss_fn(
+            params["disc"], params["gen"], bn, images, z, gp_key, labels)
+        g_loss, _ = g_loss_fn(params["gen"], params["disc"], bn, z, labels)
+        return _loss_metrics(d_loss, d_real, d_fake, g_loss, gp)
+
     def init(key):
         return init_train_state(key, cfg)
 
     return TrainStepFns(train_step=train_step, sample=sample, init=init,
-                        summarize=summarize)
+                        summarize=summarize, eval_losses=eval_losses)

@@ -46,7 +46,11 @@ from dcgan_tpu.utils.profiling import StepTimer, TraceCapture
 Pytree = Any
 
 
-def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool) -> Iterator:
+def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
+                   data_dir: Optional[str] = None,
+                   seed_offset: int = 0,
+                   n_threads: Optional[int] = None,
+                   min_after_dequeue: Optional[int] = None) -> Iterator:
     """Yields sharded image batches — (images, labels) pairs for conditional
     models (cfg.model.num_classes > 0)."""
     sharding = batch_sharding(mesh, 4, spatial=cfg.mesh.spatial)
@@ -57,22 +61,58 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool) -> Iterator:
             per_proc = cfg.batch_size // jax.process_count()
             for batch in synthetic_batches(
                     per_proc, cfg.model.output_size, cfg.model.c_dim,
-                    seed=cfg.seed + jax.process_index(),
+                    seed=cfg.seed + seed_offset + jax.process_index(),
                     num_classes=cfg.model.num_classes):
                 yield to_global(batch, sharding, label_sharding)
         return it()
     dcfg = DataConfig(
-        data_dir=cfg.data_dir,
+        data_dir=data_dir if data_dir is not None else cfg.data_dir,
         image_size=cfg.model.output_size,
         channels=cfg.model.c_dim,
         batch_size=cfg.batch_size // jax.process_count(),
         record_dtype=cfg.record_dtype,
-        min_after_dequeue=cfg.shuffle_buffer,
-        n_threads=cfg.num_loader_threads,
-        seed=cfg.seed,
+        min_after_dequeue=min_after_dequeue if min_after_dequeue is not None
+        else cfg.shuffle_buffer,
+        n_threads=n_threads if n_threads is not None
+        else cfg.num_loader_threads,
+        seed=cfg.seed + seed_offset,
         normalize=cfg.normalize_inputs,
         label_feature=cfg.label_feature if conditional else "")
     return make_dataset(dcfg, sharding, label_sharding)
+
+
+def _sample_data_iterator(cfg: TrainConfig, mesh, *,
+                          synthetic: bool) -> Optional[Iterator]:
+    """The reference's SECOND input pipeline over sample_image_dir
+    (image_train.py:84), feeding the every-100-steps sample-loss probe
+    (:179-192). Optional here: present in synthetic mode (held-out stream,
+    different seed) or when sample_image_dir exists on disk; absent
+    otherwise — the probe is skipped, not an error (the reference crashed
+    without the directory)."""
+    if synthetic:
+        return _data_iterator(cfg, mesh, synthetic=True, seed_offset=100)
+    exists = os.path.isdir(cfg.sample_image_dir)
+    if jax.process_count() > 1:
+        # The probe runs mesh-wide collectives; every process must make the
+        # same enabled/disabled decision or the job deadlocks at the first
+        # probe step. Enabled only if ALL hosts see the directory.
+        from jax.experimental import multihost_utils
+
+        all_exist = bool(np.all(multihost_utils.process_allgather(
+            np.asarray([exists]))))
+        if exists and not all_exist and is_chief():
+            print("[dcgan_tpu] sample_image_dir "
+                  f"{cfg.sample_image_dir!r} is not visible on every host; "
+                  "sample-loss probe disabled")
+        exists = all_exist
+    if exists:
+        # a light pipeline: the probe consumes one batch per 100 steps, so a
+        # small shuffle pool and few threads are plenty
+        return _data_iterator(
+            cfg, mesh, synthetic=False, data_dir=cfg.sample_image_dir,
+            seed_offset=100, n_threads=2,
+            min_after_dequeue=4 * cfg.batch_size)
+    return None
 
 
 def train(cfg: TrainConfig, *, synthetic_data: bool = False,
@@ -114,6 +154,12 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
             % cfg.model.num_classes
 
     data = _data_iterator(cfg, mesh, synthetic=synthetic_data)
+    sample_data = _sample_data_iterator(cfg, mesh, synthetic=synthetic_data) \
+        if cfg.sample_every_steps else None
+    # fixed z for the loss probe, tiled to the probe batch size (the
+    # reference feeds the same sample_z every time, image_train.py:77,181)
+    eval_z = jax.numpy.resize(sample_z, (cfg.batch_size, cfg.model.z_dim)) \
+        if sample_data is not None else None
     base_key = jax.random.key(cfg.seed + 2)
     conditional = cfg.model.num_classes > 0
 
@@ -181,6 +227,24 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
                                     f"train_{new_step:08d}.png")
                 save_sample_grid(path, imgs[:rows * cols], (rows, cols))
                 writer.write_image_event(new_step, "samples", path)
+            # held-out loss probe on the sample pipeline's batch with the
+            # fixed z — the reference's sess.run([sampler, d_loss, g_loss])
+            # + print every 100 steps (image_train.py:179-192)
+            if sample_data is not None:
+                if conditional:
+                    s_imgs, s_labels = next(sample_data)
+                    ev = pt.eval_losses(state, s_imgs, eval_z, s_labels)
+                else:
+                    s_imgs = next(sample_data)
+                    ev = pt.eval_losses(state, s_imgs, eval_z)
+                if chief:
+                    ev = {k: float(v) for k, v in ev.items()}
+                    print(f"[dcgan_tpu] [sample] step {new_step} "
+                          f"d_loss {ev['d_loss']:.8f} "
+                          f"g_loss {ev['g_loss']:.8f}")
+                    writer.write_scalars(
+                        new_step,
+                        {f"sample/{k}": v for k, v in ev.items()})
 
         trace.maybe_stop(new_step, sync=metrics)
         ckpt.maybe_save(new_step, state)

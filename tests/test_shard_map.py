@@ -85,6 +85,10 @@ def test_sample_and_summarize():
     assert int(some["count"]) == int(np.sum(some["bin_counts"]))
     assert np.isfinite(float(some["std"]))
 
+    # held-out loss probe through explicit collectives
+    ev = pt.eval_losses(s, real_batch(), z)
+    assert np.isfinite(float(ev["d_loss"])) and np.isfinite(float(ev["g_loss"]))
+
 
 def test_global_histogram_matches_unsharded():
     """activation_stats under axis_name must bin against global min/max and

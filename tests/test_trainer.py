@@ -72,6 +72,15 @@ class TestTrainLoop:
         cfg = tiny_cfg(tmp_path, activation_summary_steps=5)
         state = train(cfg, synthetic_data=True, max_steps=7)
         assert int(jax.device_get(state["step"])) == 7
+        # the held-out sample-loss probe (reference image_train.py:179-192)
+        # fired at steps 3 and 6 and wrote sample/* scalars
+        events = [json.loads(line) for line in
+                  open(os.path.join(cfg.checkpoint_dir, "events.jsonl"))]
+        sample_scalars = [e for e in events if e["kind"] == "scalars"
+                          and "sample/d_loss" in e["values"]]
+        assert {e["step"] for e in sample_scalars} == {3, 6}
+        assert all(np.isfinite(e["values"]["sample/g_loss"])
+                   for e in sample_scalars)
 
         # sample grids at steps 3 and 6 (2x2 of 8x8 images -> 32x32 PNG)
         grids = sorted(glob.glob(str(tmp_path / "samples" / "*.png")))
@@ -103,6 +112,25 @@ class TestTrainLoop:
         # final checkpoint exists at step 7
         from dcgan_tpu.utils.checkpoint import Checkpointer
         assert Checkpointer(cfg.checkpoint_dir).latest_step() == 7
+
+    def test_sample_pipeline_from_disk(self, tmp_path):
+        """sample_image_dir present -> the probe's second pipeline reads it
+        (reference image_train.py:84); absent -> probe skipped, not an
+        error."""
+        from dcgan_tpu.data import write_image_tfrecords
+        from dcgan_tpu.parallel import make_mesh
+        from dcgan_tpu.train.trainer import _sample_data_iterator
+
+        cfg = tiny_cfg(tmp_path,
+                       sample_image_dir=str(tmp_path / "sample_data"))
+        mesh = make_mesh(cfg.mesh)
+        assert _sample_data_iterator(cfg, mesh, synthetic=False) is None
+
+        write_image_tfrecords(cfg.sample_image_dir, num_examples=32,
+                              image_size=16, num_shards=1)
+        it = _sample_data_iterator(cfg, mesh, synthetic=False)
+        batch = next(it)
+        assert batch.shape == (16, 16, 16, 3)
 
     def test_resume_from_checkpoint(self, tmp_path):
         cfg = tiny_cfg(tmp_path, sample_every_steps=0)
