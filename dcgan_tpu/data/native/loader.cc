@@ -35,7 +35,11 @@
 namespace {
 
 // ---------------------------------------------------------------------------
-// CRC32C (software table; Castagnoli reflected poly 0x82F63B78)
+// CRC32C (Castagnoli). Hardware SSE4.2 crc32 instruction when the CPU has it
+// (runtime-dispatched; the instruction computes exactly this polynomial),
+// byte-table software fallback otherwise. The software path measured 2.8k
+// img/s on 64px float64 records vs 14.9k with verification off — CRC was
+// eating 5x of loader throughput until this went hardware.
 // ---------------------------------------------------------------------------
 
 struct Crc32cTable {
@@ -50,13 +54,37 @@ struct Crc32cTable {
   }
 };
 
-uint32_t crc32c(const uint8_t* data, size_t n) {
+uint32_t crc32c_sw(const uint8_t* data, size_t n) {
   static const Crc32cTable table;
   uint32_t crc = 0xFFFFFFFFu;
   for (size_t i = 0; i < n; ++i)
     crc = table.t[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
   return ~crc;
 }
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2")))
+uint32_t crc32c_hw(const uint8_t* data, size_t n) {
+  uint64_t crc = 0xFFFFFFFFu;
+  while (n >= 8) {
+    uint64_t chunk;
+    memcpy(&chunk, data, 8);  // unaligned-safe
+    crc = __builtin_ia32_crc32di(crc, chunk);
+    data += 8;
+    n -= 8;
+  }
+  uint32_t crc32 = uint32_t(crc);
+  while (n--) crc32 = __builtin_ia32_crc32qi(crc32, *data++);
+  return ~crc32;
+}
+
+uint32_t crc32c(const uint8_t* data, size_t n) {
+  static const bool hw = __builtin_cpu_supports("sse4.2");
+  return hw ? crc32c_hw(data, n) : crc32c_sw(data, n);
+}
+#else
+uint32_t crc32c(const uint8_t* data, size_t n) { return crc32c_sw(data, n); }
+#endif
 
 uint32_t masked_crc32c(const uint8_t* data, size_t n) {
   uint32_t crc = crc32c(data, n);

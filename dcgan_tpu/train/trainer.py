@@ -98,11 +98,14 @@ def _sample_data_iterator(cfg: TrainConfig, mesh, *,
         # probe step. Enabled only if ALL hosts see the directory.
         from jax.experimental import multihost_utils
 
-        all_exist = bool(np.all(multihost_utils.process_allgather(
-            np.asarray([exists]))))
-        if exists and not all_exist and is_chief():
+        gathered = multihost_utils.process_allgather(np.asarray([exists]))
+        all_exist = bool(np.all(gathered))
+        # warn on ANY partial visibility — including the chief itself missing
+        # the mount — since the probe silently disables mesh-wide
+        if bool(np.any(gathered)) and not all_exist and is_chief():
             print("[dcgan_tpu] sample_image_dir "
-                  f"{cfg.sample_image_dir!r} is not visible on every host; "
+                  f"{cfg.sample_image_dir!r} is not visible on every host "
+                  f"(visibility per process: {gathered.ravel().tolist()}); "
                   "sample-loss probe disabled")
         exists = all_exist
     if exists:

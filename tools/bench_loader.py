@@ -1,0 +1,103 @@
+"""Input-pipeline throughput benchmark (host-side; no TPU involved).
+
+SURVEY.md §7 hard part (d): the pipeline must feed >~10k images/s/host so the
+chip is never input-bound (the training step consumes ~20k img/s on one v5e
+at DCGAN-64 — bench.py). This measures the native C++ loader and the
+pure-Python fallback over synthetic shards in the reference's on-disk schema.
+
+    python tools/bench_loader.py                  # defaults: 64px f64, 16 threads
+    python tools/bench_loader.py --record_dtype uint8 --threads 4 8 16
+    python tools/bench_loader.py --data_dir /data/celeba   # real shards
+
+Prints one JSON line per configuration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dcgan_tpu.data.pipeline import PythonLoader, list_shards  # noqa: E402
+from dcgan_tpu.data.synthetic import write_image_tfrecords  # noqa: E402
+
+
+def measure(loader, batch: int, *, warmup: int = 3, batches: int = 50
+            ) -> float:
+    for _ in range(warmup):
+        loader.next()
+    t0 = time.perf_counter()
+    for _ in range(batches):
+        loader.next()
+    dt = time.perf_counter() - t0
+    return batch * batches / dt
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--data_dir", default="",
+                   help="existing TFRecord shards; default: synthetic tmp set")
+    p.add_argument("--image_size", type=int, default=64)
+    p.add_argument("--record_dtype", default="float64",
+                   choices=["float64", "float32", "uint8"])
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--num_examples", type=int, default=4096)
+    p.add_argument("--num_shards", type=int, default=8)
+    p.add_argument("--threads", type=int, nargs="+", default=[16])
+    p.add_argument("--batches", type=int, default=50)
+    p.add_argument("--python_loader", action="store_true",
+                   help="also measure the pure-Python fallback")
+    args = p.parse_args()
+
+    if args.data_dir:
+        paths = list_shards(args.data_dir)
+        tmp = None
+    else:
+        tmp = tempfile.TemporaryDirectory()
+        paths = write_image_tfrecords(
+            tmp.name, num_examples=args.num_examples,
+            image_size=args.image_size, num_shards=args.num_shards,
+            record_dtype=args.record_dtype)
+
+    shape = (args.image_size, args.image_size, 3)
+    kw = dict(batch=args.batch, example_shape=shape,
+              record_dtype=args.record_dtype,
+              min_after_dequeue=4 * args.batch, prefetch_batches=4,
+              seed=0, normalize=True, loop=True)
+
+    kinds = [("native", None)]
+    if args.python_loader:
+        kinds.append(("python", None))
+    for kind, _ in kinds:
+        for n in args.threads:
+            if kind == "native":
+                from dcgan_tpu.data.native import NativeLoader
+
+                ld = NativeLoader(paths, n_threads=n, **kw)
+            else:
+                ld = PythonLoader(paths, n_threads=n, **kw)
+            try:
+                rate = measure(ld, args.batch, batches=args.batches)
+            finally:
+                ld.close()
+            print(json.dumps({
+                "loader": kind, "threads": n,
+                # both loaders clamp readers to the shard count; report the
+                # count that actually ran, not the request
+                "effective_readers": min(n, len(paths)),
+                "record_dtype": args.record_dtype,
+                "image_size": args.image_size,
+                "images_per_sec": round(rate, 1),
+            }))
+
+    if tmp is not None:
+        tmp.cleanup()
+
+
+if __name__ == "__main__":
+    main()
