@@ -76,6 +76,11 @@ class MeshConfig:
                                    # domain analogue of sequence/context
                                    # parallelism (SURVEY.md §2.5). False =
                                    # tensor parallelism (wide weights shard)
+    shard_opt: bool = False        # ZeRO-1: shard Adam moments over the data
+                                   # axis (each replica owns 1/N and updates
+                                   # its slice; reduce-scatter/all-gather
+                                   # inserted by GSPMD — arXiv:2004.13336).
+                                   # gspmd backend only
 
     def __post_init__(self):
         if self.spatial and self.model <= 1:
@@ -189,10 +194,13 @@ class TrainConfig:
         if self.backend not in ("gspmd", "shard_map"):
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.backend == "shard_map" and (self.mesh.model != 1
-                                            or self.mesh.spatial):
+                                            or self.mesh.spatial
+                                            or self.mesh.shard_opt):
             raise ValueError(
                 "backend='shard_map' is data-parallel only (mesh.model must "
-                f"be 1, spatial False); got mesh={self.mesh}")
+                "be 1, spatial/shard_opt False — tensor/spatial/optimizer-"
+                f"state sharding live in the gspmd backend); got "
+                f"mesh={self.mesh}")
         if self.loss not in ("gan", "wgan-gp"):
             raise ValueError(f"unknown loss {self.loss!r}")
         if self.update_mode not in ("sequential", "fused"):

@@ -76,7 +76,8 @@ def make_parallel_train(cfg: TrainConfig,
     fns = make_train_step(cfg, constrain_fake=constrain_fake)
 
     state_shapes = jax.eval_shape(fns.init, jax.random.key(0))
-    shardings = state_shardings(state_shapes, mesh, spatial=spatial)
+    shardings = state_shardings(state_shapes, mesh, spatial=spatial,
+                                shard_opt=cfg.mesh.shard_opt)
     rep = replicated(mesh)
     z_sh = batch_sharding(mesh, 2)
     lbl_sh = batch_sharding(mesh, 1)

@@ -99,6 +99,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="collective strategy: gspmd = jit + sharding "
                         "annotations; shard_map = explicit per-device "
                         "psum/pmean (DP-only, composes with --use_pallas)")
+    p.add_argument("--mesh_shard_opt", action="store_true",
+                   help="ZeRO-1: shard optimizer state over the data axis "
+                        "(reduce-scatter/all-gather weight updates)")
     p.add_argument("--mesh_spatial", action="store_true",
                    help="use the model axis to shard image height instead of "
                         "weights (conv halo exchange; the sequence-parallel "
@@ -136,6 +139,7 @@ _FLAG_FIELDS = {
     "use_pallas": ("model", "use_pallas"),
     "mesh_data": ("mesh", "data"), "mesh_model": ("mesh", "model"),
     "mesh_spatial": ("mesh", "spatial"), "backend": ("", "backend"),
+    "mesh_shard_opt": ("mesh", "shard_opt"),
 }
 
 

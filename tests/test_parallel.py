@@ -80,8 +80,9 @@ def test_spatial_sharding_rules():
 
 @pytest.mark.parametrize("mesh_cfg",
                          [MeshConfig(), MeshConfig(model=2),
-                          MeshConfig(model=2, spatial=True)],
-                         ids=["dp8", "dp4xtp2", "dp4xsp2"])
+                          MeshConfig(model=2, spatial=True),
+                          MeshConfig(shard_opt=True)],
+                         ids=["dp8", "dp4xtp2", "dp4xsp2", "dp8-zero1"])
 def test_sharded_step_matches_single_device(mesh_cfg):
     """The sharded SPMD step must be numerically equivalent to the unsharded
     step — data parallelism here is synchronous (one global batch, global BN
@@ -143,6 +144,44 @@ def test_conditional_sharded_step():
     y = jnp.arange(16) % 4
     s, m = pt.step(s, real_batch(), jax.random.key(1), y)
     assert np.isfinite(float(m["d_loss"]))
+
+
+def test_zero1_opt_state_sharding():
+    """shard_opt=True (ZeRO-1, arXiv:2004.13336): Adam moments shard over
+    the data axis; params/BN stay on their usual rules; the physical shards
+    each hold 1/8 of the moment tensors."""
+    cfg = TrainConfig(model=TINY, batch_size=16,
+                      mesh=MeshConfig(shard_opt=True))
+    mesh = make_mesh(cfg.mesh)
+    fns = make_train_step(cfg)
+    shapes = jax.eval_shape(fns.init, jax.random.key(0))
+    sh = state_shardings(shapes, mesh, shard_opt=True)
+    # conv-kernel moments [5,5,in,out]: data axis lands on the first dim it
+    # divides; params themselves stay replicated (pure DP mesh)
+    leaves = jax.tree_util.tree_leaves_with_path(sh["opt"]["disc"])
+    kernel_specs = [s.spec for path, s in leaves
+                    if any(getattr(p, "key", None) == "conv1" for p in path)
+                    and any(getattr(p, "key", None) == "w" for p in path)]
+    assert kernel_specs and all("data" in tuple(s) for s in kernel_specs)
+    # params never pick up the data axis (ZeRO-1 shards only optimizer state)
+    assert "data" not in tuple(sh["params"]["disc"]["conv1"]["w"].spec)
+
+    pt = make_parallel_train(cfg, mesh)
+    state = pt.init(jax.random.key(0))
+    mu_w = state["opt"]["disc"][0].mu["conv1"]["w"]
+    full = int(np.prod(mu_w.shape))
+    shard_sizes = {int(np.prod(s.data.shape))
+                   for s in mu_w.addressable_shards}
+    assert shard_sizes == {full // 8}
+    # and the params stayed fully replicated on every device
+    w = state["params"]["disc"]["conv1"]["w"]
+    assert all(s.data.shape == w.shape for s in w.addressable_shards)
+
+
+def test_zero1_rejected_for_shard_map_backend():
+    with pytest.raises(ValueError, match="shard_opt"):
+        TrainConfig(model=TINY, backend="shard_map",
+                    mesh=MeshConfig(shard_opt=True))
 
 
 def test_g_ema_sharded():

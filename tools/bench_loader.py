@@ -70,17 +70,13 @@ def main() -> None:
               min_after_dequeue=4 * args.batch, prefetch_batches=4,
               seed=0, normalize=True, loop=True)
 
-    kinds = [("native", None)]
-    if args.python_loader:
-        kinds.append(("python", None))
-    for kind, _ in kinds:
-        for n in args.threads:
-            if kind == "native":
-                from dcgan_tpu.data.native import NativeLoader
+    from dcgan_tpu.data.native import NativeLoader
 
-                ld = NativeLoader(paths, n_threads=n, **kw)
-            else:
-                ld = PythonLoader(paths, n_threads=n, **kw)
+    kinds = ["native"] + (["python"] if args.python_loader else [])
+    for kind in kinds:
+        for n in args.threads:
+            cls = NativeLoader if kind == "native" else PythonLoader
+            ld = cls(paths, n_threads=n, **kw)
             try:
                 rate = measure(ld, args.batch, batches=args.batches)
             finally:
