@@ -118,6 +118,12 @@ class TrainConfig:
 
     # Optimization (image_train.py:11-13,109-112)
     learning_rate: float = 2e-4
+    d_learning_rate: Optional[float] = None  # TTUR: per-net learning rates
+    g_learning_rate: Optional[float] = None  # (None = learning_rate; the
+                                             # reference uses one lr for both)
+    lr_schedule: str = "constant"  # "constant" (reference) | "linear" decay
+                                   # to 0 over max_steps | "cosine" to 0
+    warmup_steps: int = 0          # linear warmup from 0 before the schedule
     beta1: float = 0.5
     batch_size: int = 64           # global batch (sharded over the data axis)
     max_steps: int = 1_200_000     # (image_train.py:150)
@@ -210,6 +216,16 @@ class TrainConfig:
         if not 0.0 <= self.g_ema_decay < 1.0:
             raise ValueError(
                 f"g_ema_decay must be in [0, 1), got {self.g_ema_decay}")
+        if self.lr_schedule not in ("constant", "linear", "cosine"):
+            raise ValueError(f"unknown lr_schedule {self.lr_schedule!r}")
+        if self.warmup_steps < 0:
+            raise ValueError(f"warmup_steps must be >= 0, got "
+                             f"{self.warmup_steps}")
+        if self.warmup_steps >= self.max_steps:
+            raise ValueError(
+                f"warmup_steps ({self.warmup_steps}) must be < max_steps "
+                f"({self.max_steps}) — the whole run would be warmup and the "
+                "decay schedule would never engage")
         if self.n_critic > 1 and self.update_mode == "fused":
             raise ValueError(
                 "update_mode='fused' (reference-parity single fused step) is "

@@ -90,6 +90,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="data-parallel axis size (-1 = all devices)")
     p.add_argument("--mesh_model", type=int, default=1,
                    help="tensor-parallel axis size")
+    p.add_argument("--d_learning_rate", type=float, default=None,
+                   help="TTUR: discriminator base lr (default: learning_rate)")
+    p.add_argument("--g_learning_rate", type=float, default=None,
+                   help="TTUR: generator base lr (default: learning_rate)")
+    p.add_argument("--lr_schedule", choices=["constant", "linear", "cosine"],
+                   default="constant",
+                   help="decay to 0 over max_steps (constant = reference)")
+    p.add_argument("--warmup_steps", type=int, default=0)
     p.add_argument("--g_ema_decay", type=float, default=0.0,
                    help="EMA decay for a shadow copy of generator weights "
                         "used for sampling (0 = off, reference parity; "
@@ -120,6 +128,9 @@ _FLAG_FIELDS = {
     "loss": ("", "loss"), "update_mode": ("", "update_mode"),
     "n_critic": ("", "n_critic"), "gp_weight": ("", "gp_weight"),
     "g_ema_decay": ("", "g_ema_decay"),
+    "d_learning_rate": ("", "d_learning_rate"),
+    "g_learning_rate": ("", "g_learning_rate"),
+    "lr_schedule": ("", "lr_schedule"), "warmup_steps": ("", "warmup_steps"),
     "dataset": ("", "dataset"), "data_dir": ("", "data_dir"),
     "sample_image_dir": ("", "sample_image_dir"),
     "record_dtype": ("", "record_dtype"),

@@ -49,10 +49,45 @@ from dcgan_tpu.train import losses as L
 Pytree = Any
 
 
-def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+def make_lr_schedule(cfg: TrainConfig, base_lr: float, *,
+                     updates_per_step: int = 1):
+    """Learning-rate schedule as an update-count -> lr callable.
+
+    "constant" is the reference's fixed 2e-4 (image_train.py:11); "linear"
+    and "cosine" decay to 0 over max_steps, with an optional linear warmup.
+    Always returned as a callable — even for constant — so the optimizer
+    state carries its count in every configuration and the checkpoint tree
+    shape never depends on the schedule flags.
+
+    `updates_per_step`: optax advances the schedule once per opt.update()
+    call, and the critic updates n_critic times per generator step — the
+    discriminator's schedule horizon is stretched by that factor so both
+    nets decay on the same *trainer-step* timeline.
+    """
+    warmup = cfg.warmup_steps * updates_per_step
+    decay_steps = max(1, cfg.max_steps * updates_per_step - warmup)
+    if cfg.lr_schedule == "constant":
+        main = optax.constant_schedule(base_lr)
+    elif cfg.lr_schedule == "linear":
+        main = optax.linear_schedule(base_lr, 0.0, decay_steps)
+    else:  # cosine
+        main = optax.cosine_decay_schedule(base_lr, decay_steps)
+    if warmup:
+        ramp = optax.linear_schedule(0.0, base_lr, warmup)
+        return optax.join_schedules([ramp, main], [warmup])
+    return main
+
+
+def make_optimizer(cfg: TrainConfig, lr: Optional[float] = None, *,
+                   updates_per_step: int = 1) -> optax.GradientTransformation:
     """Adam(lr=2e-4, β1=0.5, β2=0.999, ε=1e-8) — the reference's optimizer
-    (image_train.py:109-112; β2/ε are TF AdamOptimizer defaults)."""
-    return optax.adam(cfg.learning_rate, b1=cfg.beta1, b2=0.999, eps=1e-8)
+    (image_train.py:109-112; β2/ε are TF AdamOptimizer defaults). `lr`
+    overrides the base rate (TTUR per-net rates); the schedule applies on
+    top of whichever base is used."""
+    base_lr = cfg.learning_rate if lr is None else lr
+    return optax.adam(make_lr_schedule(cfg, base_lr,
+                                       updates_per_step=updates_per_step),
+                      b1=cfg.beta1, b2=0.999, eps=1e-8)
 
 
 def init_train_state(key, cfg: TrainConfig) -> Pytree:
@@ -63,7 +98,9 @@ def init_train_state(key, cfg: TrainConfig) -> Pytree:
     plus an EMA copy of the generator weights.
     """
     params, bn = gan_init(key, cfg.model)
-    opt = make_optimizer(cfg)
+    opt_g = make_optimizer(cfg, cfg.g_learning_rate)
+    opt_d = make_optimizer(cfg, cfg.d_learning_rate,
+                           updates_per_step=cfg.n_critic)
     # ema_gen is ALWAYS part of the state so the checkpoint tree structure is
     # independent of cfg.g_ema_decay — a checkpoint trained with EMA on
     # restores under an eval/generate/resume config with it off (and vice
@@ -73,8 +110,8 @@ def init_train_state(key, cfg: TrainConfig) -> Pytree:
         "params": params,
         "bn": bn,
         "opt": {
-            "gen": opt.init(params["gen"]),
-            "disc": opt.init(params["disc"]),
+            "gen": opt_g.init(params["gen"]),
+            "disc": opt_d.init(params["disc"]),
         },
         "ema_gen": jax.tree_util.tree_map(jnp.copy, params["gen"]),
         "step": jnp.zeros((), jnp.int32),
@@ -108,7 +145,9 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
     tests/test_parallel.py::test_sharded_step_matches_single_device[dp4xsp2]).
     """
     mcfg = cfg.model
-    opt = make_optimizer(cfg)
+    opt_g = make_optimizer(cfg, cfg.g_learning_rate)   # TTUR-capable:
+    opt_d = make_optimizer(cfg, cfg.d_learning_rate,   # per-net base rates
+                           updates_per_step=cfg.n_critic)
     wgan = cfg.loss == "wgan-gp"
     gan_losses = L.wgan_losses if wgan else L.bce_gan_losses
     _cf = constrain_fake if constrain_fake is not None else (lambda x: x)
@@ -192,8 +231,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                     params["disc"], params["gen"], bn, images, z, gp_key,
                     labels)
             d_grads = _pmean(d_grads)
-            d_updates, d_opt = opt.update(d_grads, state["opt"]["disc"],
-                                          params["disc"])
+            d_updates, d_opt = opt_d.update(d_grads, state["opt"]["disc"],
+                                            params["disc"])
             new_disc = optax.apply_updates(params["disc"], d_updates)
         else:
             # n_critic > 1 (canonical WGAN-GP: 5) — scanned critic updates
@@ -212,7 +251,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                         d_params_c, params["gen"], bn_in, images, z_i, gpk,
                         labels)
                 grads = _pmean(grads)
-                updates, d_opt_c = opt.update(grads, d_opt_c, d_params_c)
+                updates, d_opt_c = opt_d.update(grads, d_opt_c, d_params_c)
                 d_params_c = optax.apply_updates(d_params_c, updates)
                 # last iteration's metrics ride the carry; note they are
                 # evaluated at that iteration's PRE-update params (one Adam
@@ -241,8 +280,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             g_loss_fn, has_aux=True)(
                 params["gen"], g_target_disc, g_bn_in, z, labels)
         g_grads = _pmean(g_grads)
-        g_updates, g_opt = opt.update(g_grads, state["opt"]["gen"],
-                                      params["gen"])
+        g_updates, g_opt = opt_g.update(g_grads, state["opt"]["gen"],
+                                        params["gen"])
         new_gen = optax.apply_updates(params["gen"], g_updates)
 
         new_state = {

@@ -201,6 +201,87 @@ class TestTrainStep:
         assert jax.tree_util.tree_structure(s_on) == \
             jax.tree_util.tree_structure(s_off)
 
+    def test_ttur_per_net_rates(self):
+        """d_learning_rate=0 freezes D while G still moves (and vice versa) —
+        the per-net rates really reach their respective Adam applies."""
+        xs, key = real_batch(), jax.random.key(1)
+        fns = make_train_step(tiny_cfg(d_learning_rate=0.0))
+        s0 = fns.init(jax.random.key(0))
+        s1, _ = jax.jit(fns.train_step)(s0, xs, key)
+
+        def moved(a, b):
+            return max(jax.tree_util.tree_leaves(jax.tree_util.tree_map(
+                lambda x, y: float(jnp.max(jnp.abs(x - y))), a, b)))
+        assert moved(s0["params"]["disc"], s1["params"]["disc"]) == 0
+        assert moved(s0["params"]["gen"], s1["params"]["gen"]) > 0
+
+        fns = make_train_step(tiny_cfg(g_learning_rate=0.0))
+        s0 = fns.init(jax.random.key(0))
+        s1, _ = jax.jit(fns.train_step)(s0, xs, key)
+        assert moved(s0["params"]["gen"], s1["params"]["gen"]) == 0
+        assert moved(s0["params"]["disc"], s1["params"]["disc"]) > 0
+
+    def test_lr_schedules(self):
+        """Schedule curves: warmup ramps 0 -> base; linear hits 0 at
+        max_steps; cosine halves at midpoint; constant stays flat — and the
+        optimizer state tree has the same shape for every schedule flag."""
+        from dcgan_tpu.train.steps import make_lr_schedule
+
+        base = 2e-4
+        cfg = tiny_cfg(max_steps=1000)
+        const = make_lr_schedule(cfg, base)
+        np.testing.assert_allclose(float(const(0)), base)
+        np.testing.assert_allclose(float(const(999)), base)
+
+        lin = make_lr_schedule(tiny_cfg(max_steps=1000, lr_schedule="linear"),
+                               base)
+        np.testing.assert_allclose(float(lin(0)), base)
+        np.testing.assert_allclose(float(lin(500)), base / 2, rtol=1e-5)
+        np.testing.assert_allclose(float(lin(1000)), 0.0, atol=1e-12)
+
+        cos = make_lr_schedule(tiny_cfg(max_steps=1000, lr_schedule="cosine"),
+                               base)
+        np.testing.assert_allclose(float(cos(500)), base / 2, rtol=1e-5)
+
+        warm = make_lr_schedule(
+            tiny_cfg(max_steps=1000, lr_schedule="linear", warmup_steps=100),
+            base)
+        np.testing.assert_allclose(float(warm(0)), 0.0, atol=1e-12)
+        np.testing.assert_allclose(float(warm(50)), base / 2, rtol=1e-5)
+        np.testing.assert_allclose(float(warm(100)), base, rtol=1e-5)
+
+        shapes = {
+            sched: jax.tree_util.tree_structure(
+                make_train_step(tiny_cfg(lr_schedule=sched)).init(
+                    jax.random.key(0)))
+            for sched in ("constant", "linear")
+        }
+        assert shapes["constant"] == shapes["linear"]
+
+    def test_bad_schedule_rejected(self):
+        with pytest.raises(ValueError, match="lr_schedule"):
+            tiny_cfg(lr_schedule="step")
+        with pytest.raises(ValueError, match="warmup_steps"):
+            tiny_cfg(warmup_steps=-1)
+        with pytest.raises(ValueError, match="decay schedule would never"):
+            tiny_cfg(warmup_steps=2_000_000)  # >= max_steps default
+
+    def test_critic_schedule_tracks_trainer_steps(self):
+        """With n_critic=5, D's optimizer advances its schedule 5x per
+        trainer step — the horizon stretch keeps its decay aligned to the
+        generator's timeline (lr at update-count 5k equals the 1-critic lr
+        at step k)."""
+        from dcgan_tpu.train.steps import make_lr_schedule
+
+        base = 2e-4
+        cfg = tiny_cfg(max_steps=1000, lr_schedule="linear", n_critic=5,
+                       loss="wgan-gp")
+        d_sched = make_lr_schedule(cfg, base, updates_per_step=5)
+        g_sched = make_lr_schedule(cfg, base)
+        for step in (0, 250, 500, 999):
+            np.testing.assert_allclose(float(d_sched(5 * step)),
+                                       float(g_sched(step)), rtol=1e-5)
+
     def test_g_ema_decay_validated(self):
         with pytest.raises(ValueError, match="g_ema_decay"):
             tiny_cfg(g_ema_decay=1.0)
