@@ -88,6 +88,45 @@ def load_and_preprocess(path: str, *, image_size: int, crop_size: int,
     return arr
 
 
+def _clear_stale_shards(output_dir: str, overwrite: bool) -> None:
+    """Refuse (or, with overwrite, remove) shards from a previous run: the
+    pipeline treats every file as a shard, so leftovers would silently mix
+    into the dataset."""
+    stale = sorted(
+        f for f in os.listdir(output_dir)
+        if f.startswith("shard-") and f.endswith(".tfrecord"))
+    if not stale:
+        return
+    if not overwrite:
+        raise ValueError(
+            f"{output_dir} already holds {len(stale)} shard(s); pass "
+            "--overwrite to replace them")
+    for f in stale:
+        os.remove(os.path.join(output_dir, f))
+    manifest_path = os.path.join(output_dir, MANIFEST_NAME)
+    if os.path.exists(manifest_path):
+        os.remove(manifest_path)
+
+
+def _write_shards(output_dir: str, items: list, record_fn,
+                  num_shards: int, manifest: dict) -> List[str]:
+    """Split shuffled `items` into contiguous chunks, serialize each via
+    `record_fn(item) -> bytes` into shard-NNNNN.tfrecord, and write the
+    dataset.json manifest. Shared by every converter so sharding and
+    manifest behavior cannot diverge between dataset formats."""
+    num_shards = max(1, min(num_shards, len(items)))
+    paths: List[str] = []
+    bounds = np.linspace(0, len(items), num_shards + 1, dtype=int)
+    for s in range(num_shards):
+        chunk = items[bounds[s]:bounds[s + 1]]
+        shard = os.path.join(output_dir, f"shard-{s:05d}.tfrecord")
+        write_tfrecords(shard, (record_fn(item) for item in chunk))
+        paths.append(shard)
+    with open(os.path.join(output_dir, MANIFEST_NAME), "w") as f:
+        json.dump({**manifest, "num_shards": len(paths)}, f, indent=2)
+    return paths
+
+
 def convert(input_dir: str, output_dir: str, *, image_size: int = 64,
             crop_size: int = 108, channels: int = 3, num_shards: int = 8,
             record_dtype: str = "float64", labeled: bool = False,
@@ -109,53 +148,95 @@ def convert(input_dir: str, output_dir: str, *, image_size: int = 64,
     if not pairs:
         raise ValueError(f"no images found under {input_dir}")
     os.makedirs(output_dir, exist_ok=True)
-    stale = sorted(
-        f for f in os.listdir(output_dir)
-        if f.startswith("shard-") and f.endswith(".tfrecord"))
-    if stale:
-        if not overwrite:
-            raise ValueError(
-                f"{output_dir} already holds {len(stale)} shard(s); pass "
-                "--overwrite to replace them")
-        for f in stale:
-            os.remove(os.path.join(output_dir, f))
-        manifest_path = os.path.join(output_dir, MANIFEST_NAME)
-        if os.path.exists(manifest_path):
-            os.remove(manifest_path)
+    _clear_stale_shards(output_dir, overwrite)
     random.Random(seed).shuffle(pairs)
-    num_shards = max(1, min(num_shards, len(pairs)))
-    paths: List[str] = []
-    bounds = np.linspace(0, len(pairs), num_shards + 1, dtype=int)
-    for s in range(num_shards):
-        chunk = pairs[bounds[s]:bounds[s + 1]]
 
-        def records() -> Iterator[bytes]:
-            for path, label in chunk:
-                arr = load_and_preprocess(path, image_size=image_size,
-                                          crop_size=crop_size,
-                                          channels=channels)
-                feats = {feature_name: [arr.astype(record_dtype).tobytes()]}
-                if labeled:
-                    feats[label_feature] = [label]
-                yield serialize_example(feats)
+    def record_fn(pair) -> bytes:
+        path, label = pair
+        arr = load_and_preprocess(path, image_size=image_size,
+                                  crop_size=crop_size, channels=channels)
+        feats = {feature_name: [arr.astype(record_dtype).tobytes()]}
+        if labeled:
+            feats[label_feature] = [label]
+        return serialize_example(feats)
 
-        shard = os.path.join(output_dir, f"shard-{s:05d}.tfrecord")
-        write_tfrecords(shard, records())
-        paths.append(shard)
-    manifest = {
+    return _write_shards(output_dir, pairs, record_fn, num_shards, {
         "num_examples": len(pairs),
         "image_size": image_size,
         "crop_size": crop_size,
         "channels": channels,
         "record_dtype": record_dtype,
-        "num_shards": len(paths),
         "classes": classes,
         "feature_name": feature_name,
         "label_feature": label_feature if labeled else "",
-    }
-    with open(os.path.join(output_dir, MANIFEST_NAME), "w") as f:
-        json.dump(manifest, f, indent=2)
-    return paths
+    })
+
+
+_CIFAR10_CLASSES = ["airplane", "automobile", "bird", "cat", "deer",
+                    "dog", "frog", "horse", "ship", "truck"]
+
+
+def convert_cifar10(input_dir: str, output_dir: str, *,
+                    split: str = "train", image_size: int = 32,
+                    num_shards: int = 8, record_dtype: str = "uint8",
+                    feature_name: str = "image_raw",
+                    label_feature: str = "label", seed: int = 0,
+                    overwrite: bool = False) -> List[str]:
+    """CIFAR-10 python-version batches -> labeled TFRecord shards.
+
+    Reads the standard `cifar-10-batches-py` pickles (data_batch_1..5 for
+    train, test_batch for test): each holds N x 3072 uint8 rows in
+    R,G,B-plane order plus a labels list. Feeds the `cifar10-cond` preset
+    (class-conditional DCGAN — the config activating the reference's dead
+    `y` argument, distriubted_model.py:83).
+    """
+    import pickle
+
+    names = ([f"data_batch_{i}" for i in range(1, 6)] if split == "train"
+             else ["test_batch"])
+    xs, ys = [], []
+    for name in names:
+        path = os.path.join(input_dir, name)
+        if not os.path.exists(path):
+            raise FileNotFoundError(
+                f"{path} not found — expected the cifar-10-batches-py "
+                "layout")
+        with open(path, "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        xs.append(np.asarray(batch[b"data"], dtype=np.uint8))
+        ys.extend(int(v) for v in batch[b"labels"])
+    # N x 3072 plane-order rows -> NHWC
+    images = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+
+    os.makedirs(output_dir, exist_ok=True)
+    _clear_stale_shards(output_dir, overwrite)
+    order = list(range(len(images)))
+    random.Random(seed).shuffle(order)
+
+    def record_fn(idx) -> bytes:
+        arr = images[idx].astype(np.float64)
+        if image_size != 32:
+            from PIL import Image
+
+            arr = np.asarray(
+                Image.fromarray(images[idx]).resize(
+                    (image_size, image_size), Image.BILINEAR),
+                dtype=np.float64)
+        return serialize_example({
+            feature_name: [arr.astype(record_dtype).tobytes()],
+            label_feature: [ys[idx]],
+        })
+
+    return _write_shards(output_dir, order, record_fn, num_shards, {
+        "num_examples": len(order),
+        "image_size": image_size,
+        "crop_size": 0,
+        "channels": 3,
+        "record_dtype": record_dtype,
+        "classes": _CIFAR10_CLASSES,
+        "feature_name": feature_name,
+        "label_feature": label_feature,
+    })
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -165,20 +246,26 @@ def build_parser() -> argparse.ArgumentParser:
                     "training pipeline reads.")
     p.add_argument("--input_dir", required=True)
     p.add_argument("--output_dir", required=True)
-    p.add_argument("--image_size", type=int, default=64,
-                   help="output resolution (reference output_size)")
+    p.add_argument("--image_size", type=int, default=None,
+                   help="output resolution (default 64; 32 with --cifar10)")
     p.add_argument("--crop_size", type=int, default=108,
                    help="center-crop source size before resizing; 0 disables "
                         "(the reference's intended image_size=108 crop, "
                         "image_train.py:17)")
     p.add_argument("--channels", type=int, default=3)
     p.add_argument("--num_shards", type=int, default=8)
-    p.add_argument("--record_dtype", default="float64",
+    p.add_argument("--record_dtype", default=None,
                    choices=["float64", "float32", "uint8"],
-                   help="on-disk pixel dtype; float64 matches the reference "
-                        "(image_input.py:48), uint8 is 8x smaller")
+                   help="on-disk pixel dtype; default float64 (matches the "
+                        "reference, image_input.py:48) or uint8 with "
+                        "--cifar10; uint8 is 8x smaller")
     p.add_argument("--labeled", action="store_true",
                    help="class subdirectories -> int64 label feature")
+    p.add_argument("--cifar10", action="store_true",
+                   help="input_dir is a cifar-10-batches-py directory; "
+                        "writes labeled 32x32 records (cifar10-cond preset)")
+    p.add_argument("--split", choices=["train", "test"], default="train",
+                   help="CIFAR-10 split (with --cifar10)")
     p.add_argument("--seed", type=int, default=0,
                    help="shuffle seed for example-to-shard assignment")
     p.add_argument("--overwrite", action="store_true",
@@ -188,11 +275,21 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
     args = build_parser().parse_args(argv)
-    paths = convert(args.input_dir, args.output_dir,
-                    image_size=args.image_size, crop_size=args.crop_size,
-                    channels=args.channels, num_shards=args.num_shards,
-                    record_dtype=args.record_dtype, labeled=args.labeled,
-                    seed=args.seed, overwrite=args.overwrite)
+    if args.cifar10:
+        paths = convert_cifar10(
+            args.input_dir, args.output_dir, split=args.split,
+            image_size=args.image_size or 32,
+            num_shards=args.num_shards,
+            record_dtype=args.record_dtype or "uint8",
+            seed=args.seed, overwrite=args.overwrite)
+    else:
+        paths = convert(args.input_dir, args.output_dir,
+                        image_size=args.image_size or 64,
+                        crop_size=args.crop_size,
+                        channels=args.channels, num_shards=args.num_shards,
+                        record_dtype=args.record_dtype or "float64",
+                        labeled=args.labeled,
+                        seed=args.seed, overwrite=args.overwrite)
     print(f"wrote {len(paths)} shards to {args.output_dir}")
 
 

@@ -159,6 +159,69 @@ class TestConvert:
             convert(src, str(tmp_path / "out"), labeled=True)
 
 
+class TestCifar10:
+    def _write_fake_batches(self, d, per_batch=10):
+        """Fabricate the cifar-10-batches-py layout: uint8 rows in
+        R,G,B-plane order + labels. Pixel value encodes the label so the
+        image<->label pairing is checkable after shuffling."""
+        import pickle
+
+        os.makedirs(d, exist_ok=True)
+        names = [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]
+        for bi, name in enumerate(names):
+            data = np.zeros((per_batch, 3072), np.uint8)
+            labels = [(bi + j) % 10 for j in range(per_batch)]
+            for j, lbl in enumerate(labels):
+                data[j] = 20 * lbl + 5  # constant image per label
+            with open(os.path.join(d, name), "wb") as f:
+                pickle.dump({b"data": data, b"labels": labels}, f)
+
+    def test_convert_and_roundtrip(self, tmp_path):
+        from dcgan_tpu.data.prepare import convert_cifar10
+
+        src, dst = str(tmp_path / "cifar"), str(tmp_path / "recs")
+        self._write_fake_batches(src)
+        paths = convert_cifar10(src, dst, num_shards=2)
+        assert len(paths) == 2
+        manifest = json.load(open(os.path.join(dst, "dataset.json")))
+        assert manifest["num_examples"] == 50  # 5 train batches x 10
+        assert manifest["classes"][0] == "airplane"
+        assert manifest["record_dtype"] == "uint8"
+
+        cfg = DataConfig(data_dir=dst, image_size=32, batch_size=10,
+                         record_dtype="uint8", min_after_dequeue=4,
+                         n_threads=1, seed=0, normalize=False, loop=False,
+                         label_feature="label")
+        imgs, labels = next(iter(make_dataset(cfg)))
+        for img, lbl in zip(np.asarray(imgs), np.asarray(labels)):
+            np.testing.assert_allclose(img, 20 * int(lbl) + 5)
+
+    def test_cli_defaults_uint8_for_cifar(self, tmp_path):
+        """main() resolves record_dtype per mode: cifar10 -> uint8 unless
+        the user asks otherwise (float64 would be 8x larger for no reason)."""
+        from dcgan_tpu.data.prepare import main
+
+        src = str(tmp_path / "cifar")
+        self._write_fake_batches(src)
+        out = str(tmp_path / "recs")
+        main(["--input_dir", src, "--output_dir", out, "--cifar10",
+              "--num_shards", "1"])
+        manifest = json.load(open(os.path.join(out, "dataset.json")))
+        assert manifest["record_dtype"] == "uint8"
+        assert manifest["image_size"] == 32
+
+    def test_test_split_and_missing_files(self, tmp_path):
+        from dcgan_tpu.data.prepare import convert_cifar10
+
+        src = str(tmp_path / "cifar")
+        self._write_fake_batches(src)
+        convert_cifar10(src, str(tmp_path / "t"), split="test", num_shards=1)
+        manifest = json.load(open(str(tmp_path / "t" / "dataset.json")))
+        assert manifest["num_examples"] == 10
+        with pytest.raises(FileNotFoundError, match="data_batch"):
+            convert_cifar10(str(tmp_path / "empty"), str(tmp_path / "o"))
+
+
 def test_cli_parser():
     args = build_parser().parse_args(
         ["--input_dir", "a", "--output_dir", "b", "--record_dtype", "uint8",
