@@ -139,6 +139,19 @@ class TestTrainLoop:
         state = train(cfg, synthetic_data=True, max_steps=6)
         assert int(jax.device_get(state["step"])) == 6
 
+    def test_resume_with_zero1_sharded_opt_state(self, tmp_path):
+        """ZeRO-1 round-trip through Orbax: the data-sharded Adam moments
+        save from and restore into their sharded layout."""
+        cfg = tiny_cfg(tmp_path, sample_every_steps=0,
+                       mesh=MeshConfig(shard_opt=True))
+        train(cfg, synthetic_data=True, max_steps=2)
+        state = train(cfg, synthetic_data=True, max_steps=4)
+        assert int(jax.device_get(state["step"])) == 4
+        mu_w = state["opt"]["disc"][0].mu["conv1"]["w"]
+        full = int(np.prod(mu_w.shape))
+        assert {int(np.prod(s.data.shape))
+                for s in mu_w.addressable_shards} == {full // 8}
+
     def test_conditional_loop(self, tmp_path):
         cfg = tiny_cfg(
             tmp_path,
