@@ -34,6 +34,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_classes", type=int, default=0)
     p.add_argument("--feature_npz", default=None,
                    help="optional trained embedder weights (evals/features.py)")
+    p.add_argument("--use_ema", action="store_true",
+                   help="score the EMA generator weights (trained with "
+                        "--g_ema_decay > 0) instead of the live weights")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None)
     return p
@@ -61,7 +64,9 @@ def main(argv: Optional[List[str]] = None) -> None:
                           z_dim=args.z_dim, gf_dim=args.gf_dim,
                           df_dim=args.df_dim, num_classes=args.num_classes),
         batch_size=args.batch_size,
-        checkpoint_dir=args.checkpoint_dir)
+        checkpoint_dir=args.checkpoint_dir,
+        # any value > 0 makes sample() read state["ema_gen"]
+        g_ema_decay=0.999 if args.use_ema else 0.0)
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
 

@@ -52,6 +52,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--class_id", type=int, default=None,
                    help="conditional models: generate only this class "
                         "(default: cycle all classes)")
+    p.add_argument("--use_ema", action="store_true",
+                   help="sample from the EMA generator weights the checkpoint "
+                        "carries (trained with --g_ema_decay > 0); default "
+                        "samples the live weights")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None)
     return p
@@ -112,7 +116,9 @@ def generate(args: argparse.Namespace) -> dict:
         grid = (rows, cols)
 
     cfg = TrainConfig(model=mcfg, batch_size=args.batch_size,
-                      checkpoint_dir=args.checkpoint_dir)
+                      checkpoint_dir=args.checkpoint_dir,
+                      # any value > 0 makes sample() read state["ema_gen"]
+                      g_ema_decay=0.999 if args.use_ema else 0.0)
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
 

@@ -45,6 +45,31 @@ class TestGenerate:
         assert np.abs(data["images"]).max() <= 1.0
         assert "labels" not in data
 
+    def test_use_ema_selects_ema_weights(self, tmp_path):
+        """--use_ema samples state['ema_gen']; after 2 steps at decay 0.5
+        the EMA and live weights differ, so the outputs must too."""
+        cfg = TrainConfig(
+            model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                              compute_dtype="float32"),
+            batch_size=8, g_ema_decay=0.5,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            sample_dir=str(tmp_path / "samples"),
+            sample_every_steps=0, save_summaries_secs=1e9,
+            save_model_secs=1e9, log_every_steps=0)
+        train(cfg, synthetic_data=True, max_steps=2)
+        outs = {}
+        for flag in (False, True):
+            argv = ["--checkpoint_dir", cfg.checkpoint_dir,
+                    "--out_dir", str(tmp_path / f"out{flag}"),
+                    "--num_images", "8", "--batch_size", "8", "--grid", "0",
+                    "--npz", str(tmp_path / f"g{flag}.npz"),
+                    "--output_size", "16", "--gf_dim", "8", "--df_dim", "8"]
+            if flag:
+                argv.append("--use_ema")
+            generate(build_parser().parse_args(argv))
+            outs[flag] = np.load(tmp_path / f"g{flag}.npz")["images"]
+        assert float(np.abs(outs[True] - outs[False]).max()) > 0
+
     def test_no_checkpoint_errors(self, tmp_path):
         args = build_parser().parse_args(
             ["--checkpoint_dir", str(tmp_path / "nope"),
