@@ -171,6 +171,11 @@ class TrainConfig:
     sample_every_steps: int = 100
     sample_grid: Tuple[int, int] = (8, 8)   # 8x8 grid (image_train.py:205)
     log_every_steps: int = 1
+    nan_check_steps: int = 100     # every N steps all processes verify the
+                                   # loss metrics are finite and abort with
+                                   # step context if not (0 = off) — the
+                                   # numerical-health hook SURVEY.md §5 names
+                                   # as this design's sanitizer equivalent
     activation_summary_steps: int = 500  # per-layer activation histogram +
                                          # sparsity cadence (0 = off). Step-
                                          # gated, not time-gated: the summary

@@ -191,6 +191,19 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
             state, metrics = pt.step(state, images, key)
         new_step = step_num + 1
 
+        # Numerical-health gate (SURVEY.md §5: the sanitizer-equivalent this
+        # design carries instead of the reference's race tolerance): every
+        # process checks the same replicated metrics, so a NaN/Inf kills the
+        # whole job in unison with step context instead of silently training
+        # garbage — or deadlocking multi-host if only one process bailed.
+        if cfg.nan_check_steps and new_step % cfg.nan_check_steps == 0:
+            vals = {k: float(v) for k, v in metrics.items()}
+            if not all(np.isfinite(v) for v in vals.values()):
+                raise FloatingPointError(
+                    f"non-finite training metrics at step {new_step}: "
+                    f"{vals} — inspect the last checkpoint in "
+                    f"{cfg.checkpoint_dir}")
+
         if chief and cfg.log_every_steps and \
                 new_step % cfg.log_every_steps == 0:
             m = {k: float(v) for k, v in metrics.items()}

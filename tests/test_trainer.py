@@ -152,6 +152,16 @@ class TestTrainLoop:
         assert {int(np.prod(s.data.shape))
                 for s in mu_w.addressable_shards} == {full // 8}
 
+    def test_nan_check_aborts_with_context(self, tmp_path):
+        """A NaN learning rate poisons D in the first update, so the G loss
+        (computed against the updated D in sequential mode) is already NaN
+        at step 1; the health gate must abort with step context instead of
+        training garbage."""
+        cfg = tiny_cfg(tmp_path, sample_every_steps=0,
+                       learning_rate=float("nan"), nan_check_steps=1)
+        with pytest.raises(FloatingPointError, match="step 1"):
+            train(cfg, synthetic_data=True, max_steps=5)
+
     def test_conditional_loop(self, tmp_path):
         cfg = tiny_cfg(
             tmp_path,
