@@ -46,6 +46,29 @@ class ParallelTrain:
     summarize: Callable  # (state, images, key[, labels]) -> activation stats
     eval_losses: Callable  # (state, images, z[, labels]) -> loss metrics
                            # on a held-out batch, no state update
+    multi_step: Callable   # (state, images [K,B,...], keys [K][, labels
+                           # [K,B]]) -> (state, last step's metrics): K train
+                           # steps as ONE compiled lax.scan program — one
+                           # host dispatch instead of K (the host round-trip
+                           # the reference paid per step, SURVEY.md §2.4 #10,
+                           # amortized K-fold)
+
+
+def make_multi_step_body(step_fn: Callable) -> Callable:
+    """K train steps as one lax.scan over `step_fn`, returning the final
+    state and the LAST step's metrics. Shared by both backends so the scan
+    carry/metrics semantics cannot diverge."""
+    def multi_body(state, images, keys, labels=None):
+        def body(s, xs):
+            if labels is None:
+                img, key = xs
+                return step_fn(s, img, key)
+            img, key, lbl = xs
+            return step_fn(s, img, key, lbl)
+        xs = (images, keys) if labels is None else (images, keys, labels)
+        state, ms = jax.lax.scan(body, state, xs)
+        return state, {k: v[-1] for k, v in ms.items()}
+    return multi_body
 
 
 def make_parallel_train(cfg: TrainConfig,
@@ -85,6 +108,13 @@ def make_parallel_train(cfg: TrainConfig,
 
     init = jax.jit(fns.init, out_shardings=shardings)
 
+    multi_body = make_multi_step_body(fns.train_step)
+
+    # scanned-batch shardings: step axis in front, batch sharded on axis 1
+    def _scan_sh(base):
+        return jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(None, *base.spec))
+
     if conditional:
         step = jax.jit(
             fns.train_step,
@@ -103,6 +133,11 @@ def make_parallel_train(cfg: TrainConfig,
             fns.eval_losses,
             in_shardings=(shardings, img_sh, z_sh, lbl_sh),
             out_shardings=rep)
+        multi_step = jax.jit(
+            multi_body,
+            in_shardings=(shardings, _scan_sh(img_sh), rep, _scan_sh(lbl_sh)),
+            out_shardings=(shardings, rep),
+            donate_argnums=(0,))
     else:
         step = jax.jit(
             fns.train_step,
@@ -121,7 +156,13 @@ def make_parallel_train(cfg: TrainConfig,
             fns.eval_losses,
             in_shardings=(shardings, img_sh, z_sh),
             out_shardings=rep)
+        multi_step = jax.jit(
+            multi_body,
+            in_shardings=(shardings, _scan_sh(img_sh), rep),
+            out_shardings=(shardings, rep),
+            donate_argnums=(0,))
 
     return ParallelTrain(mesh=mesh, cfg=cfg, shardings=shardings,
                          init=init, step=step, sample=sample,
-                         summarize=summarize, eval_losses=eval_losses)
+                         summarize=summarize, eval_losses=eval_losses,
+                         multi_step=multi_step)

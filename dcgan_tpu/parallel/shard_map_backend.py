@@ -41,7 +41,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dcgan_tpu.config import TrainConfig
-from dcgan_tpu.parallel.api import ParallelTrain
+from dcgan_tpu.parallel.api import ParallelTrain, make_multi_step_body
 from dcgan_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS, make_mesh
 from dcgan_tpu.parallel.sharding import replicated
 from dcgan_tpu.train.steps import make_train_step
@@ -136,10 +136,25 @@ def make_shard_map_train(cfg: TrainConfig,
         eval_losses = jax.jit(
             smap(fns.eval_losses, (P(), img_spec, z_spec), P()))
 
+    # K steps in one per-shard program (see ParallelTrain.multi_step);
+    # step_body folds the shard index into each key
+    multi_body = make_multi_step_body(step_body)
+    scan_img = P(None, *img_spec)
+    if conditional:
+        multi_step = jax.jit(
+            smap(multi_body, (P(), scan_img, P(), P(None, *lbl_spec)),
+                 (P(), P())),
+            donate_argnums=(0,))
+    else:
+        multi_step = jax.jit(
+            smap(multi_body, (P(), scan_img, P()), (P(), P())),
+            donate_argnums=(0,))
+
     init = jax.jit(fns.init, out_shardings=rep)
 
     shardings = jax.tree_util.tree_map(
         lambda _: rep, jax.eval_shape(fns.init, jax.random.key(0)))
     return ParallelTrain(mesh=mesh, cfg=cfg, shardings=shardings,
                          init=init, step=step, sample=sample,
-                         summarize=summarize, eval_losses=eval_losses)
+                         summarize=summarize, eval_losses=eval_losses,
+                         multi_step=multi_step)
