@@ -194,6 +194,15 @@ class TrainConfig:
     # Misc
     seed: int = 0
     sample_size: int = 64          # fixed-z sample batch (image_train.py:43)
+    steps_per_call: int = 1        # >1: dispatch K steps as one compiled
+                                   # lax.scan program (ParallelTrain.
+                                   # multi_step) — sheds per-dispatch RPC
+                                   # overhead (~7ms over a tunneled
+                                   # transport). Observability cadences
+                                   # must be 0 or multiples of K; per-step
+                                   # stdout logging (the reference's
+                                   # every-step line) only reports each
+                                   # call's last step
     backend: str = "gspmd"         # "gspmd": jit + sharding annotations, the
                                    # partitioner inserts collectives
                                    # (parallel/api.py) | "shard_map": explicit
@@ -231,6 +240,31 @@ class TrainConfig:
                 f"warmup_steps ({self.warmup_steps}) must be < max_steps "
                 f"({self.max_steps}) — the whole run would be warmup and the "
                 "decay schedule would never engage")
+        if self.steps_per_call < 1:
+            raise ValueError(
+                f"steps_per_call must be >= 1, got {self.steps_per_call}")
+        if self.steps_per_call > 1:
+            cadences = {
+                "log_every_steps": self.log_every_steps,
+                "sample_every_steps": self.sample_every_steps,
+                "activation_summary_steps": self.activation_summary_steps,
+                "nan_check_steps": self.nan_check_steps,
+                "save_model_steps": self.save_model_steps,
+            }
+            # A cadence that is a multiple of K fires exactly on schedule; a
+            # cadence that divides K fires at every call boundary (e.g. the
+            # default per-step log becomes one line per call, reporting the
+            # call's last step). Anything else would fire on a skewed subset
+            # of its steps — reject that.
+            spc = self.steps_per_call
+            bad = {k: v for k, v in cadences.items()
+                   if v and v % spc != 0 and spc % v != 0}
+            if bad:
+                raise ValueError(
+                    f"with steps_per_call={spc} every step cadence must be "
+                    "0, a multiple of it (fires on schedule), or a divisor "
+                    "of it (fires each call boundary); offending: "
+                    f"{bad}")
         if self.n_critic > 1 and self.update_mode == "fused":
             raise ValueError(
                 "update_mode='fused' (reference-parity single fused step) is "

@@ -102,6 +102,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="EMA decay for a shadow copy of generator weights "
                         "used for sampling (0 = off, reference parity; "
                         "typical 0.999)")
+    p.add_argument("--steps_per_call", type=int, default=1,
+                   help=">1 dispatches K steps as one compiled scan program "
+                        "(sheds per-dispatch RPC overhead; observability "
+                        "cadences must be multiples of K)")
     p.add_argument("--backend", choices=["gspmd", "shard_map"],
                    default="gspmd",
                    help="collective strategy: gspmd = jit + sharding "
@@ -131,6 +135,7 @@ _FLAG_FIELDS = {
     "d_learning_rate": ("", "d_learning_rate"),
     "g_learning_rate": ("", "g_learning_rate"),
     "lr_schedule": ("", "lr_schedule"), "warmup_steps": ("", "warmup_steps"),
+    "steps_per_call": ("", "steps_per_call"),
     "dataset": ("", "dataset"), "data_dir": ("", "data_dir"),
     "sample_image_dir": ("", "sample_image_dir"),
     "record_dtype": ("", "record_dtype"),

@@ -179,17 +179,46 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
     # step_num is tracked on the host (it equals state["step"], which the
     # trainer fully determines) — touching the device array every iteration
     # would force a per-step host sync and serialize the pipeline.
-    for step_num in range(start_step, total_steps):
+    step_num = start_step
+    while step_num < total_steps:
+        # steps_per_call > 1: dispatch K steps as one scanned program when
+        # aligned to a K boundary with K steps remaining (a checkpoint
+        # restore can land mid-boundary; single steps realign, and the
+        # tail below max_steps runs single too). Keys are per-step
+        # fold-ins, identical to the single-step path, so a run produces
+        # the same step keys whatever the call size.
+        k = cfg.steps_per_call
+        if not (k > 1 and step_num % k == 0 and step_num + k <= total_steps):
+            k = 1
         trace.maybe_start(step_num)
-        key = jax.random.fold_in(base_key, step_num)
         labels = None
-        if conditional:
-            images, labels = next(data)
-            state, metrics = pt.step(state, images, key, labels)
+        if k == 1:
+            key = jax.random.fold_in(base_key, step_num)
+            if conditional:
+                images, labels = next(data)
+                state, metrics = pt.step(state, images, key, labels)
+            else:
+                images = next(data)
+                state, metrics = pt.step(state, images, key)
         else:
-            images = next(data)
-            state, metrics = pt.step(state, images, key)
-        new_step = step_num + 1
+            # one vmapped dispatch for all K per-step keys (a python loop of
+            # fold_ins would pay K of the per-dispatch overheads this path
+            # exists to shed); same per-step keys as the single-step path
+            keys = jax.vmap(jax.random.fold_in, (None, 0))(
+                base_key, jax.numpy.arange(step_num, step_num + k))
+            key = keys[-1]  # for the cadence consumers below (summaries)
+            if conditional:
+                pairs = [next(data) for _ in range(k)]
+                imgs_k = jax.numpy.stack([p[0] for p in pairs])
+                lbls_k = jax.numpy.stack([p[1] for p in pairs])
+                state, metrics = pt.multi_step(state, imgs_k, keys, lbls_k)
+                images, labels = pairs[-1]
+            else:
+                batches = [next(data) for _ in range(k)]
+                imgs_k = jax.numpy.stack(batches)
+                state, metrics = pt.multi_step(state, imgs_k, keys)
+                images = batches[-1]
+        new_step = step_num + k
 
         # Numerical-health gate (SURVEY.md §5: the sanitizer-equivalent this
         # design carries instead of the reference's race tolerance): every
@@ -214,7 +243,7 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
         # With per-step logging (the default, matching the reference's
         # every-step stdout log) the float() sync above makes this true step
         # latency; with log_every_steps=0 it measures dispatch cadence only.
-        timer.tick()
+        timer.tick(steps=k)
 
         if chief and writer.ready():
             writer.write_scalars(new_step,
@@ -264,6 +293,7 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
 
         trace.maybe_stop(new_step, sync=metrics)
         ckpt.maybe_save(new_step, state)
+        step_num = new_step
 
     trace.close()
     writer.close()

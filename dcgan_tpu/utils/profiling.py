@@ -38,11 +38,14 @@ class StepTimer:
         self._durations: collections.deque = collections.deque(maxlen=window)
         self._last: Optional[float] = None
 
-    def tick(self, now: Optional[float] = None) -> None:
-        """Mark the end of one step; the first call only arms the timer."""
+    def tick(self, now: Optional[float] = None, steps: int = 1) -> None:
+        """Mark the end of `steps` training steps (a multi-step dispatch
+        counts each scanned step); the first call only arms the timer."""
         now = time.perf_counter() if now is None else now
         if self._last is not None:
-            self._durations.append(now - self._last)
+            per_step = (now - self._last) / max(1, steps)
+            for _ in range(max(1, steps)):
+                self._durations.append(per_step)
         self._last = now
 
     def __len__(self) -> int:

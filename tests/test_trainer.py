@@ -152,6 +152,27 @@ class TestTrainLoop:
         assert {int(np.prod(s.data.shape))
                 for s in mu_w.addressable_shards} == {full // 8}
 
+    def test_steps_per_call_scanned_dispatch(self, tmp_path):
+        """steps_per_call=3 over 7 steps: two scanned calls + one aligned
+        single step; cadence events still fire at the right steps and the
+        final count is exact."""
+        cfg = tiny_cfg(tmp_path, steps_per_call=3, sample_every_steps=3,
+                       activation_summary_steps=6, nan_check_steps=3,
+                       save_model_steps=999)
+        state = train(cfg, synthetic_data=True, max_steps=7)
+        assert int(jax.device_get(state["step"])) == 7
+        events = [json.loads(line) for line in
+                  open(os.path.join(cfg.checkpoint_dir, "events.jsonl"))]
+        sample_steps = {e["step"] for e in events if e["kind"] == "scalars"
+                        and "sample/d_loss" in e["values"]}
+        assert sample_steps == {3, 6}
+        assert {e["step"] for e in events if e["kind"] == "activations"} \
+            == {6}
+
+    def test_steps_per_call_cadence_validation(self, tmp_path):
+        with pytest.raises(ValueError, match="multiple"):
+            tiny_cfg(tmp_path, steps_per_call=4, sample_every_steps=3)
+
     def test_nan_check_aborts_with_context(self, tmp_path):
         """A NaN learning rate poisons D in the first update, so the G loss
         (computed against the updated D in sequential mode) is already NaN
