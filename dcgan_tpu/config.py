@@ -127,7 +127,8 @@ class TrainConfig:
     beta1: float = 0.5
     batch_size: int = 64           # global batch (sharded over the data axis)
     max_steps: int = 1_200_000     # (image_train.py:150)
-    loss: str = "gan"              # "gan" (BCE, image_train.py:91-96) | "wgan-gp"
+    loss: str = "gan"              # "gan" (BCE, image_train.py:91-96) |
+                                   # "wgan-gp" | "hinge" (SAGAN-style)
     gp_weight: float = 10.0        # WGAN-GP gradient-penalty coefficient
     n_critic: int = 1              # D updates per G update. 1 = the reference's
                                    # one-D-one-G step (image_train.py:156-158);
@@ -221,7 +222,7 @@ class TrainConfig:
                 "be 1, spatial/shard_opt False — tensor/spatial/optimizer-"
                 f"state sharding live in the gspmd backend); got "
                 f"mesh={self.mesh}")
-        if self.loss not in ("gan", "wgan-gp"):
+        if self.loss not in ("gan", "wgan-gp", "hinge"):
             raise ValueError(f"unknown loss {self.loss!r}")
         if self.update_mode not in ("sequential", "fused"):
             raise ValueError(f"unknown update_mode {self.update_mode!r}")

@@ -35,7 +35,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=64,
                    help="global batch size (sharded over the data axis)")
     p.add_argument("--max_steps", type=int, default=1_200_000)
-    p.add_argument("--loss", choices=["gan", "wgan-gp"], default="gan")
+    p.add_argument("--loss", choices=["gan", "wgan-gp", "hinge"],
+                   default="gan")
     p.add_argument("--update_mode", choices=["sequential", "fused"],
                    default="sequential")
     p.add_argument("--n_critic", type=int, default=1,

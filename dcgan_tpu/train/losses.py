@@ -56,6 +56,22 @@ def wgan_losses(real_logits: jax.Array, fake_logits: jax.Array
     return d_loss_real + d_loss_fake, d_loss_real, d_loss_fake, g_loss
 
 
+def hinge_losses(real_logits: jax.Array, fake_logits: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Geometric-GAN / SAGAN hinge losses (beyond-reference loss family):
+
+        d_loss_real = E[relu(1 - D(real))]
+        d_loss_fake = E[relu(1 + D(fake))]
+        g_loss      = -E[D(fake)]
+
+    Same arity as `bce_gan_losses` so the train step is loss-agnostic.
+    """
+    d_loss_real = jnp.mean(jax.nn.relu(1.0 - real_logits))
+    d_loss_fake = jnp.mean(jax.nn.relu(1.0 + fake_logits))
+    g_loss = -jnp.mean(fake_logits)
+    return d_loss_real + d_loss_fake, d_loss_real, d_loss_fake, g_loss
+
+
 def gradient_penalty(critic_fn: Callable[[jax.Array], jax.Array],
                      real: jax.Array, fake: jax.Array,
                      key: jax.Array) -> jax.Array:

@@ -149,7 +149,9 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
     opt_d = make_optimizer(cfg, cfg.d_learning_rate,   # per-net base rates
                            updates_per_step=cfg.n_critic)
     wgan = cfg.loss == "wgan-gp"
-    gan_losses = L.wgan_losses if wgan else L.bce_gan_losses
+    gan_losses = {"gan": L.bce_gan_losses,
+                  "wgan-gp": L.wgan_losses,
+                  "hinge": L.hinge_losses}[cfg.loss]
     _cf = constrain_fake if constrain_fake is not None else (lambda x: x)
 
     def _pmean(x):
@@ -208,10 +210,12 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         _, fake_logits, _ = discriminator_apply(
             d_params, bn["disc"], fake, cfg=mcfg, train=True, labels=labels,
             axis_name=axis_name)
-        if wgan:
-            g_loss = -jnp.mean(fake_logits)
-        else:  # non-saturating BCE generator loss (image_train.py:96)
-            g_loss = L.sigmoid_bce(fake_logits, 1.0)
+        # the family's own generator loss (4th return) — single-sourced with
+        # the D-side dispatch; every family's g_loss depends only on the
+        # fake logits, so the real-logits slot gets a dummy (its unused
+        # d-side outputs are DCE'd by XLA). BCE: non-saturating generator
+        # loss (image_train.py:96).
+        g_loss = gan_losses(fake_logits, fake_logits)[3]
         return g_loss, (g_bn,)
 
     def train_step(state: Pytree, images: jax.Array, key: jax.Array,

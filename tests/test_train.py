@@ -64,6 +64,19 @@ class TestLosses:
         np.testing.assert_allclose(float(d), -2.0 + 1.0, rtol=1e-6)
         np.testing.assert_allclose(float(g), -1.0, rtol=1e-6)
 
+    def test_hinge_losses_golden(self):
+        """relu margins: real logits above 1 and fake below -1 cost nothing;
+        inside the margin the cost is linear."""
+        from dcgan_tpu.train.losses import hinge_losses
+
+        r = jnp.array([2.0, 0.5])    # relu(1-2)=0, relu(1-0.5)=0.5
+        f = jnp.array([-3.0, 0.0])   # relu(1-3)=0, relu(1+0)=1
+        d, dr, df, g = hinge_losses(r, f)
+        np.testing.assert_allclose(float(dr), 0.25, rtol=1e-6)
+        np.testing.assert_allclose(float(df), 0.5, rtol=1e-6)
+        np.testing.assert_allclose(float(d), 0.75, rtol=1e-6)
+        np.testing.assert_allclose(float(g), 1.5, rtol=1e-6)  # -mean(f)
+
     def test_gradient_penalty_golden(self):
         """For D(x) = a.x, grad norm is ||a|| everywhere: gp = (||a||-1)^2."""
         a = jnp.array([3.0, 4.0])  # ||a|| = 5
@@ -125,6 +138,17 @@ class TestTrainStep:
         s, m = jax.jit(fns.train_step)(s, real_batch(), jax.random.key(1))
         assert "gp" in m and np.isfinite(float(m["gp"]))
         assert np.isfinite(float(m["d_loss"]))
+
+    def test_hinge_step(self):
+        fns = make_train_step(tiny_cfg(loss="hinge"))
+        s0 = fns.init(jax.random.key(0))
+        s1, m = jax.jit(fns.train_step)(s0, real_batch(), jax.random.key(1))
+        assert "gp" not in m
+        assert all(np.isfinite(float(v)) for v in m.values())
+        moved = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))),
+            s0["params"], s1["params"])
+        assert max(jax.tree_util.tree_leaves(moved)) > 0
 
     def test_n_critic_scan(self):
         """n_critic=3 runs three scanned critic updates per step: the critic
