@@ -30,15 +30,16 @@ V100_TF_BASELINE_IMG_PER_SEC = 2000.0
 # BENCH_* env overrides exist for local smoke runs (e.g. BENCH_PLATFORM=cpu
 # BENCH_BATCH=8 BENCH_STEPS=3); the driver's TPU run uses the defaults.
 BATCH = int(os.environ.get("BENCH_BATCH", 64))
-STEPS_MEASURE = int(os.environ.get("BENCH_STEPS", 200))
+STEPS_MEASURE = int(os.environ.get("BENCH_STEPS", 400))
 STEPS_WARMUP = 5
 # Steps per dispatched program (ParallelTrain.multi_step, a lax.scan): over
 # the tunneled transport each dispatch costs up to ~7 ms of RPC overhead —
-# per-step dispatch measured 5.5k img/s where scan-20 measured 19.3k on the
-# same chip minutes apart. 1 = the plain per-step path (also the default for
-# CPU smoke runs, where compiling the scanned program costs minutes).
-# Clamped to BENCH_STEPS so a smoke run never exceeds the requested steps.
-_SCAN_DEFAULT = 1 if os.environ.get("BENCH_PLATFORM") == "cpu" else 20
+# per-step dispatch measured 5.5k img/s where scan-20 measured 19.3k and
+# scan-50 21.4k on the same chip minutes apart. 1 = the plain per-step path
+# (also the default for CPU smoke runs, where compiling the scanned program
+# costs minutes). Clamped to BENCH_STEPS so a smoke run never exceeds the
+# requested steps.
+_SCAN_DEFAULT = 1 if os.environ.get("BENCH_PLATFORM") == "cpu" else 50
 SCAN = max(1, min(int(os.environ.get("BENCH_SCAN", _SCAN_DEFAULT)),
                   STEPS_MEASURE))
 

@@ -53,7 +53,7 @@ class TestCLI:
 
     def test_bad_flag_rejected(self):
         with pytest.raises(SystemExit):
-            build_parser().parse_args(["--loss", "hinge"])
+            build_parser().parse_args(["--loss", "lsgan"])
 
     def test_mesh_spatial_flag_reaches_config(self):
         args = build_parser().parse_args(["--mesh_model", "2",
