@@ -41,6 +41,14 @@ class ModelConfig:
     use_pallas: bool = False       # fused Pallas BN+activation kernels
                                    # (ops/pallas_kernels.py; single-chip /
                                    # per-shard execution)
+    attn_res: int = 0              # >0 inserts a SAGAN-style self-attention
+                                   # block (ops/attention.py) into both stacks
+                                   # at the stage whose feature maps are
+                                   # attn_res x attn_res (e.g. 32 for the
+                                   # SAGAN-64 recipe). Under a spatial mesh the
+                                   # block executes as sequence-parallel ring
+                                   # attention. 0 = off (reference parity: the
+                                   # reference is pure conv)
 
     def __post_init__(self):
         n = self.num_up_layers
@@ -48,6 +56,12 @@ class ModelConfig:
             raise ValueError(
                 f"output_size={self.output_size} must be base_size*2^k with "
                 f"k >= 1 (base_size={self.base_size})")
+        if self.attn_res:
+            sites = {self.base_size * (2 ** j) for j in range(n)}
+            if self.attn_res not in sites:
+                raise ValueError(
+                    f"attn_res={self.attn_res} is not a feature-map "
+                    f"resolution of this stack; choose one of {sorted(sites)}")
 
     @property
     def num_up_layers(self) -> int:

@@ -20,7 +20,10 @@ Extensions beyond the reference (BASELINE.json configs):
 - num_classes > 0 activates class conditioning (the reference's `y` argument is
   accepted-but-ignored, distriubted_model.py:83 / SURVEY.md §2.4 #7): one-hot
   labels concat onto z for G and broadcast as constant channel maps onto the
-  image for D.
+  image for D;
+- attn_res > 0 inserts a SAGAN self-attention block (ops/attention.py) into
+  both stacks at that feature-map resolution; `attn_mesh` routes it through
+  sequence-parallel ring attention when the spatial mesh shards image height.
 
 Params/state are plain nested dicts so `jax.tree_util` / optax / checkpointing
 all work without a framework dependency.
@@ -28,12 +31,14 @@ all work without a framework dependency.
 
 from __future__ import annotations
 
+import math
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from dcgan_tpu.config import ModelConfig
+from dcgan_tpu.ops.attention import attn_apply, attn_init
 from dcgan_tpu.ops.layers import (
     conv2d_apply,
     conv2d_init,
@@ -85,6 +90,13 @@ def generator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
             bn_p, bn_s = batch_norm_init(keys[2 * i + 1], out_ch, dtype=dtype)
             params[f"bn{i}"], state[f"bn{i}"] = bn_p, bn_s
         in_ch = out_ch
+    if cfg.attn_res:
+        # channels of the stage whose output feature map is attn_res:
+        # stage 0 (base_size) has top_ch; stage i (base_size*2^i) has
+        # gf_dim * 2^(k-1-i). keys[2k+1] is unused above (stage k has no BN).
+        i = int(round(math.log2(cfg.attn_res / cfg.base_size)))
+        ch = top_ch if i == 0 else cfg.gf_dim * (2 ** (k - 1 - i))
+        params["attn"] = attn_init(keys[2 * k + 1], ch, dtype=dtype)
     return params, state
 
 
@@ -92,6 +104,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
                     cfg: ModelConfig, train: bool,
                     labels: Optional[jax.Array] = None,
                     axis_name: Optional[str] = None,
+                    attn_mesh=None,
                     capture: Optional[dict] = None
                     ) -> Tuple[jax.Array, Pytree]:
     """z [B, z_dim] (-1..1) -> image [B, S, S, c_dim] in tanh range.
@@ -124,6 +137,9 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
         params["bn0"], state["bn0"], h, train=train,
         momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name,
         act="relu", use_pallas=cfg.use_pallas)
+    if cfg.attn_res == cfg.base_size:
+        h = attn_apply(params["attn"], h, compute_dtype=cdt,
+                       seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
     if capture is not None:
         capture["h0"] = h
 
@@ -134,6 +150,10 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
                 params[f"bn{i}"], state[f"bn{i}"], h, train=train,
                 momentum=cfg.bn_momentum, eps=cfg.bn_eps,
                 axis_name=axis_name, act="relu", use_pallas=cfg.use_pallas)
+            if cfg.attn_res == cfg.base_size * (2 ** i):
+                h = attn_apply(params["attn"], h, compute_dtype=cdt,
+                               seq_mesh=attn_mesh,
+                               use_pallas=cfg.use_pallas)
             if capture is not None:
                 capture[f"h{i}"] = h
 
@@ -179,6 +199,13 @@ def discriminator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
 
     flat = cfg.base_size * cfg.base_size * cfg.df_dim * (2 ** (k - 1))
     params["head"] = linear_init(keys[-1], flat, 1, dtype=dtype)
+    if cfg.attn_res:
+        # stage i's output feature map is output_size / 2^(i+1) with
+        # df_dim * 2^i channels. keys[2k] is unused above: conv keys are the
+        # even indices 0..2k-2, BN keys the odd 3..2k-1, head takes 2k+1.
+        i = int(round(math.log2(cfg.output_size / cfg.attn_res))) - 1
+        params["attn"] = attn_init(keys[2 * k], cfg.df_dim * (2 ** i),
+                                   dtype=dtype)
     return params, state
 
 
@@ -186,6 +213,7 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
                         cfg: ModelConfig, train: bool,
                         labels: Optional[jax.Array] = None,
                         axis_name: Optional[str] = None,
+                        attn_mesh=None,
                         capture: Optional[dict] = None
                         ) -> Tuple[jax.Array, jax.Array, Pytree]:
     """image [B, S, S, c] -> (sigmoid(logit), logit [B, 1], new_bn_state).
@@ -217,6 +245,9 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
                 use_pallas=cfg.use_pallas)
         else:
             h = lrelu(h, cfg.leak)
+        if cfg.attn_res and cfg.attn_res == cfg.output_size >> (i + 1):
+            h = attn_apply(params["attn"], h, compute_dtype=cdt,
+                           seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
         if capture is not None:
             capture[f"h{i}"] = h
 

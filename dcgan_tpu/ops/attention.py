@@ -1,0 +1,182 @@
+"""Self-attention over the spatial sequence, with a ring-attention path for
+sequence-parallel execution.
+
+The reference has no attention anywhere — it is a pure-conv DCGAN whose
+largest spatial extent is 64x64 (distriubted_model.py:7,83-128), and SURVEY.md
+§2.5 records sequence/context parallelism as structurally absent. This module
+is the framework's first-class long-context machinery anyway: images flatten
+to a sequence of H*W spatial positions, a SAGAN-style self-attention block
+(Zhang et al. 2018, arXiv:1805.08318) attends over that sequence, and when the
+sequence is sharded over a mesh axis the attention runs as a **ring**:
+each device keeps its query block resident and rotates key/value blocks around
+the axis with `lax.ppermute`, folding each incoming block into a numerically
+stable online softmax (the blockwise/flash recurrence of Ring Attention,
+arXiv:2310.01889). Peak memory per device is O(S_local^2) instead of O(S^2),
+no device ever materializes the full sequence, and the transfers ride ICI
+neighbor links.
+
+Design notes:
+- `attn_apply` is identity at initialization: the residual gate `gamma` starts
+  at 0 (the SAGAN recipe), so inserting the block into a DCGAN stack does not
+  perturb the reference dynamics until training moves gamma.
+- Projections are 1x1 convs expressed as channel matmuls: query/key to C/8,
+  value to C/2, output back to C — the SAGAN channel plan.
+- Logits are scaled by 1/sqrt(d_k) (standard scaled dot-product; SAGAN's paper
+  omits the scale — documented divergence, it only re-scales what gamma=0
+  already gates) and accumulated in float32 regardless of compute dtype.
+- `ring_attention` is exact: full-vs-ring equivalence is asserted to f32
+  tolerance in tests/test_attention.py on an 8-virtual-device mesh, gradients
+  included (ppermute and the scan recurrence are differentiable as-is).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from dcgan_tpu.ops.layers import linear_apply, linear_init
+
+Pytree = dict
+
+
+def attn_init(key, ch: int, *, dtype=jnp.float32) -> Pytree:
+    """Parameters for one self-attention block over `ch`-channel feature maps.
+
+    SAGAN channel plan: query/key project to ch//8, value to ch//2, output
+    back to ch; `gamma` (the residual gate) starts at 0 so the block is the
+    identity at init.
+    """
+    if ch < 8:
+        raise ValueError(f"attention needs >= 8 channels, got {ch}")
+    kq, kk, kv, ko = jax.random.split(key, 4)
+    return {
+        "query": linear_init(kq, ch, ch // 8, dtype=dtype),
+        "key": linear_init(kk, ch, ch // 8, dtype=dtype),
+        "value": linear_init(kv, ch, ch // 2, dtype=dtype),
+        "out": linear_init(ko, ch // 2, ch, dtype=dtype),
+        "gamma": jnp.zeros((), dtype),
+    }
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   *, scale: float) -> jax.Array:
+    """softmax(q k^T * scale) v over the whole sequence. [B,S,d] each; the
+    softmax/accumulation run in float32 whatever the input dtype."""
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkv->bqv", p, v.astype(jnp.float32))
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   axis_name: str, n_shards: int, scale: float) -> jax.Array:
+    """Exact attention over a sequence sharded along `axis_name`.
+
+    Per-device blocks q,k,v: [B, S_local, d]. The device keeps q resident and
+    receives each of the `n_shards` k/v blocks in turn over a `ppermute` ring,
+    maintaining the online-softmax statistics (running max m, normalizer l,
+    unnormalized accumulator acc) so the result equals full softmax attention
+    over the global sequence (arXiv:2310.01889's blockwise recurrence).
+
+    Communication: exactly n_shards-1 neighbor exchanges of the local k/v
+    blocks — O(S_local * d) per hop on ICI; nothing ever all-gathers. The
+    resident block folds before the scan, so no hop's result is discarded.
+    """
+    if n_shards == 1:
+        return full_attention(q, k, v, scale=scale)
+    fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    qf = q.astype(jnp.float32)
+
+    def fold(k_blk, v_blk, m, l, acc):
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_blk.astype(jnp.float32)) * scale
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # exp(-inf - -inf) cannot occur: m_new is finite from the first fold
+        # on, and there m = -inf only on the correction side
+        # (corr = exp(-inf - finite) = 0, which correctly discards the empty
+        # accumulator).
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bqk,bkv->bqv", p, v_blk.astype(jnp.float32))
+        return m_new, l, acc
+
+    # Build the accumulators out of q/v arithmetic (not jnp.zeros) so they
+    # inherit the operands' device-varying axes — the scan carry then
+    # type-checks under shard_map's VMA tracking over ANY enclosing mesh
+    # (the ring axis alone, or ring + a batch axis).
+    zero_q = qf[..., 0] * 0.0                       # [B, S]
+    m, l, acc = fold(k, v, zero_q - jnp.inf, zero_q,
+                     zero_q[..., None] * v[:, :1, :].astype(jnp.float32))
+
+    def body(carry, _):
+        k_blk, v_blk, m, l, acc = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm=fwd)
+        v_blk = lax.ppermute(v_blk, axis_name, perm=fwd)
+        m, l, acc = fold(k_blk, v_blk, m, l, acc)
+        return (k_blk, v_blk, m, l, acc), None
+
+    (_, _, _, l, acc), _ = lax.scan(
+        body, (k, v, m, l, acc), None, length=n_shards - 1)
+    return acc / l[..., None]
+
+
+def _project(params: Pytree, x: jax.Array, cdt) -> Tuple[jax.Array, ...]:
+    q = linear_apply(params["query"], x, compute_dtype=cdt)
+    k = linear_apply(params["key"], x, compute_dtype=cdt)
+    v = linear_apply(params["value"], x, compute_dtype=cdt)
+    return q, k, v
+
+
+def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
+               seq_mesh=None, seq_axis: str = "model",
+               batch_axis: str = "data",
+               use_pallas: bool = False) -> jax.Array:
+    """x [B,H,W,C] -> x + gamma * attention(x) (same shape/dtype).
+
+    seq_mesh=None: attention over the full flattened H*W sequence (under a
+    data-parallel jit the batch dim shards and nothing else changes).
+    use_pallas=True routes this dense path through the flash-attention Pallas
+    kernels (ops/pallas_attention.py) — O(S) HBM traffic, no [S, S] score
+    matrix ever materialized.
+
+    seq_mesh=<Mesh>: sequence-parallel execution — the flattened sequence is
+    sharded over `seq_axis` (the mesh layout MeshConfig.spatial produces:
+    batch over "data", image height over "model") and attention runs as a
+    `shard_map` ring over that axis, nested inside the caller's jit. The
+    surrounding convs stay under the GSPMD partitioner (halo exchanges); only
+    the attention — whose all-to-all token mixing the partitioner would
+    otherwise lower to a full k/v all-gather — is written as an explicit ring.
+    """
+    B, H, W, C = x.shape
+    cdt = compute_dtype
+    seq = x.reshape(B, H * W, C)
+    q, k, v = _project(params, seq, cdt)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+
+    if seq_mesh is not None and seq_mesh.shape[seq_axis] > 1:
+        n = seq_mesh.shape[seq_axis]
+        if (H * W) % n:
+            raise ValueError(
+                f"sequence {H}x{W} does not shard over {n} devices")
+        spec = P(batch_axis, seq_axis, None)
+        ring = jax.shard_map(
+            functools.partial(ring_attention, axis_name=seq_axis,
+                              n_shards=n, scale=scale),
+            mesh=seq_mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        out = ring(q, k, v)
+    elif use_pallas:
+        from dcgan_tpu.ops.pallas_attention import flash_attention
+
+        out = flash_attention(q, k, v, scale)
+    else:
+        out = full_attention(q, k, v, scale=scale)
+
+    out = linear_apply(params["out"], out.astype(v.dtype), compute_dtype=cdt)
+    gamma = params["gamma"].astype(x.dtype)
+    return x + gamma * out.reshape(B, H, W, C).astype(x.dtype)

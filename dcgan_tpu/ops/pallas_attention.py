@@ -1,0 +1,237 @@
+"""Pallas TPU flash attention: blocked online-softmax forward + flash backward.
+
+The attention block (ops/attention.py) is the framework's long-context hot op
+— images flatten to an H*W token sequence and DCGAN's conv stacks turn into
+SAGAN stacks (ModelConfig.attn_res). XLA lowers dense attention as materialize
+-softmax-matmul: the [S, S] score matrix crosses HBM twice per direction. This
+module is the memory-optimal form (Flash Attention, arXiv:2205.14135,
+expressed as TPU Pallas kernels): scores live only as [TQ, TK] VMEM tiles, an
+online softmax folds each tile into running (max, normalizer, accumulator)
+statistics, and the backward recomputes tiles from the saved log-sum-exp
+instead of reading a stored probability matrix. O(S) HBM traffic in S instead
+of O(S^2) — the property that makes sequence length a free axis.
+
+Layout notes (TPU):
+- Blocks are [TQ, d] / [TK, d] with TQ = TK = 128 (the MXU systolic edge);
+  `q @ k^T` and `p @ v` land on the MXU with f32 accumulation
+  (`preferred_element_type`).
+- Grid is (B, S/TQ) for forward/dq and (B, S/TK) for dk/dv — the kernel loops
+  over the opposite axis with `lax.fori_loop`, keeping per-program state in
+  VMEM scratch.
+- The head dims here are narrow (SAGAN: d_qk = C/8, d_v = C/2); they ride the
+  lane axis zero-padded. That wastes lanes but not HBM, and the kernels are
+  shape-agnostic — the same code serves wide heads.
+- Off-TPU the kernels run under `interpret=True`, so the CPU test mesh
+  exercises the identical code path (tests/test_pallas_attention.py asserts
+  exactness against ops/attention.py::full_attention, gradients included).
+
+Composition: `ops/attention.py::attn_apply(use_pallas=True)` routes its dense
+path here (single chip, or per-shard under the shard_map backend — pallas_call
+is opaque to the GSPMD partitioner, same constraint as ops/pallas_kernels.py).
+Under a spatial mesh the ring path already achieves O(S_local^2) tiles; ring
+hops and flash tiles solve the same problem at two different levels, so they
+are not nested.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+BLOCK = 128  # MXU edge; q/k tile rows
+
+_NEG_INF = -1e30  # finite stand-in for -inf: keeps exp()/max() NaN-free
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block(s: int) -> int:
+    """Largest tile <= BLOCK dividing s (sequence lengths here are powers of
+    two times small factors; a divisor always exists for the supported
+    shapes)."""
+    b = min(s, BLOCK)
+    while s % b:
+        b -= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, tk):
+    q = q_ref[0].astype(jnp.float32)                    # [TQ, d]
+    tq = q.shape[0]
+    dv = v_ref.shape[-1]
+    n_k = k_ref.shape[1] // tk
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * tk, tk), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * tk, tk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.dot(p, vb,
+                                   preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((tq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((tq, 1), jnp.float32)
+    acc0 = jnp.zeros((tq, dv), jnp.float32)
+    m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # log-sum-exp per row — the single vector the backward needs to
+    # reconstruct p tiles without storing them
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _fwd_impl(q, k, v, scale):
+    B, S, dk = q.shape
+    dv = v.shape[-1]
+    tq, tk = _block(S), _block(S)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, tk=tk),
+        grid=(B, S // tq),
+        in_specs=[pl.BlockSpec((1, tq, dk), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, S, dk), lambda b, i: (b, 0, 0)),
+                  pl.BlockSpec((1, S, dv), lambda b, i: (b, 0, 0))],
+        out_specs=(pl.BlockSpec((1, tq, dv), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, tq), lambda b, i: (b, i))),
+        out_shape=(jax.ShapeDtypeStruct((B, S, dv), jnp.float32),
+                   jax.ShapeDtypeStruct((B, S), jnp.float32)),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, tk):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    tq, dk = q.shape
+    n_k = k_ref.shape[1] // tk
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * tk, tk), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * tk, tk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                             # [TQ, TK]
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, kb,
+                            preferred_element_type=jnp.float32) * scale
+
+    dq = lax.fori_loop(0, n_k, body, jnp.zeros((tq, dk), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, tq):
+    kb = k_ref[0].astype(jnp.float32)                    # [TK, dk]
+    vb = v_ref[0].astype(jnp.float32)                    # [TK, dv]
+    tk, dkd = kb.shape
+    dvd = vb.shape[-1]
+    n_q = q_ref.shape[1] // tq
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(i * tq, tq), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * tq, tq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * tq, tq)][:, None]
+        delta = delta_ref[0, pl.ds(i * tq, tq)][:, None]
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                             # [TQ, TK]
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                            # [TQ, TK]
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    dk_acc, dv_acc = lax.fori_loop(
+        0, n_q, body, (jnp.zeros((tk, dkd), jnp.float32),
+                       jnp.zeros((tk, dvd), jnp.float32)))
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _bwd_impl(scale, res, g):
+    q, k, v, out, lse = res
+    B, S, dk = q.shape
+    dv = v.shape[-1]
+    tq, tk = _block(S), _block(S)
+    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
+    # one fused elementwise reduction, XLA handles it
+    delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1)          # [B, S]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, tk=tk),
+        grid=(B, S // tq),
+        in_specs=[pl.BlockSpec((1, tq, dk), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, S, dk), lambda b, i: (b, 0, 0)),
+                  pl.BlockSpec((1, S, dv), lambda b, i: (b, 0, 0)),
+                  pl.BlockSpec((1, tq, dv), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, tq), lambda b, i: (b, i)),
+                  pl.BlockSpec((1, tq), lambda b, i: (b, i))],
+        out_specs=pl.BlockSpec((1, tq, dk), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, S, dk), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+
+    dk_arr, dv_arr = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, tq=tq),
+        grid=(B, S // tk),
+        in_specs=[pl.BlockSpec((1, S, dk), lambda b, j: (b, 0, 0)),
+                  pl.BlockSpec((1, tk, dk), lambda b, j: (b, j, 0)),
+                  pl.BlockSpec((1, tk, dv), lambda b, j: (b, j, 0)),
+                  pl.BlockSpec((1, S, dv), lambda b, j: (b, 0, 0)),
+                  pl.BlockSpec((1, S), lambda b, j: (b, 0)),
+                  pl.BlockSpec((1, S), lambda b, j: (b, 0))],
+        out_specs=(pl.BlockSpec((1, tk, dk), lambda b, j: (b, j, 0)),
+                   pl.BlockSpec((1, tk, dv), lambda b, j: (b, j, 0))),
+        out_shape=(jax.ShapeDtypeStruct((B, S, dk), k.dtype),
+                   jax.ShapeDtypeStruct((B, S, dv), v.dtype)),
+        interpret=_interpret(),
+    )(q, k, v, g, lse, delta)
+    return dq.astype(q.dtype), dk_arr, dv_arr
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    scale: float) -> jax.Array:
+    """softmax(q k^T * scale) v over [B, S, d] blocks without ever
+    materializing the [S, S] score matrix in HBM. Returns float32 (matching
+    ops/attention.py::full_attention's accumulation contract)."""
+    out, _ = _fwd_impl(q, k, v, scale)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, scale):
+    out, lse = _fwd_impl(q, k, v, scale)
+    return out, (q, k, v, out, lse)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _bwd_impl)

@@ -96,7 +96,12 @@ def make_parallel_train(cfg: TrainConfig,
         # while the real branch is height-sharded, and its shared-conv-kernel
         # gradient comes out double-counted (~2x) — see make_train_step.
         constrain_fake = lambda x: jax.lax.with_sharding_constraint(x, img_sh)
-    fns = make_train_step(cfg, constrain_fake=constrain_fake)
+    # Under a spatial mesh, attention blocks run as sequence-parallel ring
+    # attention over the "model" axis (shard_map nested in the jitted step)
+    # instead of letting the partitioner all-gather k/v (ops/attention.py).
+    attn_mesh = mesh if (spatial and cfg.model.attn_res) else None
+    fns = make_train_step(cfg, constrain_fake=constrain_fake,
+                          attn_mesh=attn_mesh)
 
     state_shapes = jax.eval_shape(fns.init, jax.random.key(0))
     shardings = state_shardings(state_shapes, mesh, spatial=spatial,

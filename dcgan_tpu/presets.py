@@ -18,6 +18,12 @@ tests/bench code) can materialize them without repeating knob soup:
 - ``wgan-gp``     — WGAN-GP loss variant: Wasserstein critic + gradient
   penalty (grad-of-grad), canonical lr 1e-4 / β1 0 hyperparameters.
 
+Plus one beyond-BASELINE family:
+
+- ``sagan64``     — self-attention GAN (hinge + TTUR + EMA, attention at
+  32x32), whose attention block is the framework's sequence-parallel
+  (ring-attention) showcase under ``--mesh_spatial``.
+
 Every preset factory takes overrides as keyword arguments forwarded to
 `dataclasses.replace`-style reconstruction, so the CLI's explicit flags win
 over preset defaults.
@@ -80,12 +86,28 @@ def wgan_gp(**overrides) -> TrainConfig:
     return dataclasses.replace(cfg, **overrides)
 
 
+def sagan64(**overrides) -> TrainConfig:
+    """Self-attention GAN on 64x64: DCGAN stacks with attention at 32x32.
+
+    The canonical SAGAN recipe (Zhang et al. 2018): hinge loss, TTUR
+    (d_lr 4e-4 / g_lr 1e-4), beta1=0, generator weight EMA. Beyond-reference
+    model family; under `--mesh_spatial` the attention runs as
+    sequence-parallel ring attention (ops/attention.py).
+    """
+    cfg = _build(ModelConfig(output_size=64, attn_res=32), MeshConfig(),
+                 batch_size=64, loss="hinge", beta1=0.0,
+                 d_learning_rate=4e-4, g_learning_rate=1e-4,
+                 g_ema_decay=0.999)
+    return dataclasses.replace(cfg, **overrides)
+
+
 PRESETS: Dict[str, Callable[..., TrainConfig]] = {
     "celeba64": celeba64,
     "lsun64-dp8": lsun64_dp8,
     "dcgan128": dcgan128,
     "cifar10-cond": cifar10_cond,
     "wgan-gp": wgan_gp,
+    "sagan64": sagan64,
 }
 
 

@@ -53,6 +53,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help=">0 = class-conditional G/D")
     p.add_argument("--use_pallas", action="store_true",
                    help="fused Pallas BN+activation kernels (single-chip)")
+    p.add_argument("--attn_res", type=int, default=0,
+                   help=">0 inserts SAGAN self-attention into both stacks at "
+                        "this feature-map resolution (ring attention under "
+                        "--mesh_spatial); 0 = off")
     # data (image_train.py:19-26)
     p.add_argument("--dataset", default="celebA")
     p.add_argument("--data_dir", default="train")
@@ -154,6 +158,7 @@ _FLAG_FIELDS = {
     "z_dim": ("model", "z_dim"), "gf_dim": ("model", "gf_dim"),
     "df_dim": ("model", "df_dim"), "num_classes": ("model", "num_classes"),
     "use_pallas": ("model", "use_pallas"),
+    "attn_res": ("model", "attn_res"),
     "mesh_data": ("mesh", "data"), "mesh_model": ("mesh", "model"),
     "mesh_spatial": ("mesh", "spatial"), "backend": ("", "backend"),
     "mesh_shard_opt": ("mesh", "shard_opt"),

@@ -132,8 +132,8 @@ class TrainStepFns:
 
 
 def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
-                    constrain_fake: Optional[Callable] = None
-                    ) -> TrainStepFns:
+                    constrain_fake: Optional[Callable] = None,
+                    attn_mesh=None) -> TrainStepFns:
     """constrain_fake, if given, is applied to every generator output that is
     fed to the discriminator during training. The parallel layer passes a
     `with_sharding_constraint` to the real-image sharding here when the mesh
@@ -174,17 +174,18 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                   images: jax.Array, z: jax.Array, gp_key,
                   labels) -> Tuple[jax.Array, Tuple]:
         fake, _ = generator_apply(g_params, bn["gen"], z, cfg=mcfg, train=True,
-                                  labels=labels, axis_name=axis_name)
+                                  labels=labels, axis_name=axis_name,
+                                  attn_mesh=attn_mesh)
         fake = _cf(fake)
         # D sees real then fake, chaining BN state through both applications —
         # the functional analogue of the reference's two discriminator() calls
         # with reuse=True (image_train.py:82,85).
         _, real_logits, d_bn1 = discriminator_apply(
             d_params, bn["disc"], images, cfg=mcfg, train=True, labels=labels,
-            axis_name=axis_name)
+            axis_name=axis_name, attn_mesh=attn_mesh)
         _, fake_logits, d_bn2 = discriminator_apply(
             d_params, d_bn1, fake, cfg=mcfg, train=True, labels=labels,
-            axis_name=axis_name)
+            axis_name=axis_name, attn_mesh=attn_mesh)
         d_loss, d_real, d_fake = gan_losses(real_logits, fake_logits)[:3]
         gp = jnp.zeros((), jnp.float32)
         if wgan:
@@ -195,7 +196,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             def critic(x):
                 return discriminator_apply(
                     d_params, bn["disc"], x, cfg=mcfg, train=False,
-                    labels=labels, axis_name=axis_name)[1][:, 0]
+                    labels=labels, axis_name=axis_name,
+                    attn_mesh=attn_mesh)[1][:, 0]
             gp = L.gradient_penalty(critic, images.astype(jnp.float32),
                                     fake.astype(jnp.float32), gp_key)
             d_loss = d_loss + cfg.gp_weight * gp
@@ -205,11 +207,11 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                   z: jax.Array, labels) -> Tuple[jax.Array, Tuple]:
         fake, g_bn = generator_apply(g_params, bn["gen"], z, cfg=mcfg,
                                      train=True, labels=labels,
-                                     axis_name=axis_name)
+                                     axis_name=axis_name, attn_mesh=attn_mesh)
         fake = _cf(fake)
         _, fake_logits, _ = discriminator_apply(
             d_params, bn["disc"], fake, cfg=mcfg, train=True, labels=labels,
-            axis_name=axis_name)
+            axis_name=axis_name, attn_mesh=attn_mesh)
         # the family's own generator loss (4th return) — single-sourced with
         # the D-side dispatch; every family's g_loss depends only on the
         # fake logits, so the real-logits slot gets a dummy (its unused
@@ -332,10 +334,11 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         g_cap: dict = {}
         d_cap: dict = {}
         generator_apply(params["gen"], bn["gen"], z, cfg=mcfg, train=True,
-                        labels=labels, axis_name=axis_name, capture=g_cap)
+                        labels=labels, axis_name=axis_name,
+                        attn_mesh=attn_mesh, capture=g_cap)
         discriminator_apply(params["disc"], bn["disc"], images, cfg=mcfg,
                             train=True, labels=labels, axis_name=axis_name,
-                            capture=d_cap)
+                            attn_mesh=attn_mesh, capture=d_cap)
         acts = {**{f"gen/{k}": v for k, v in g_cap.items()},
                 **{f"disc/{k}": v for k, v in d_cap.items()}}
         return activation_stats(acts, axis_name=axis_name)

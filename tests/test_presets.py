@@ -9,9 +9,11 @@ from dcgan_tpu.train.cli import apply_overrides, explicit_flags
 
 class TestPresets:
     def test_all_baseline_configs_named(self):
-        # BASELINE.json lists exactly these five configurations.
+        # BASELINE.json lists exactly five configurations; sagan64 is the
+        # beyond-BASELINE attention family (presets.py docstring).
         assert set(PRESETS) == {
-            "celeba64", "lsun64-dp8", "dcgan128", "cifar10-cond", "wgan-gp"}
+            "celeba64", "lsun64-dp8", "dcgan128", "cifar10-cond", "wgan-gp",
+            "sagan64"}
 
     def test_celeba64_is_reference_headline(self):
         cfg = get_preset("celeba64")
@@ -41,6 +43,13 @@ class TestPresets:
         assert cfg.loss == "wgan-gp"
         assert cfg.learning_rate == 1e-4 and cfg.beta1 == 0.0
         assert cfg.n_critic == 5
+
+    def test_sagan64_recipe(self):
+        cfg = get_preset("sagan64")
+        assert cfg.model.attn_res == 32
+        assert cfg.loss == "hinge" and cfg.beta1 == 0.0
+        assert cfg.d_learning_rate == 4e-4 and cfg.g_learning_rate == 1e-4
+        assert cfg.g_ema_decay == 0.999
 
     def test_factory_overrides(self):
         cfg = get_preset("celeba64", batch_size=128, seed=7)
