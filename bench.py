@@ -59,7 +59,10 @@ def main() -> None:
     n_chips = len(jax.devices())
     cfg = TrainConfig(
         model=ModelConfig(          # 64x64, gf=df=64, bf16 compute
-            use_pallas=os.environ.get("BENCH_PALLAS", "") == "1"),
+            use_pallas=os.environ.get("BENCH_PALLAS", "") == "1",
+            # BENCH_ATTN=1: the sagan64 architecture (self-attention at
+            # 32x32); with BENCH_PALLAS=1 the block runs the flash kernels
+            attn_res=32 if os.environ.get("BENCH_ATTN", "") == "1" else 0),
         batch_size=BATCH * n_chips,
         mesh=MeshConfig(),
         backend=os.environ.get("BENCH_BACKEND", "gspmd"))
@@ -118,8 +121,9 @@ def main() -> None:
 
     img_per_sec = cfg.batch_size * steps_window / dt
     img_per_sec_chip = img_per_sec / n_chips
+    arch = "SAGAN-64" if cfg.model.attn_res else "DCGAN-64"
     print(json.dumps({
-        "metric": f"DCGAN-64 train throughput (batch {BATCH}/chip, bf16)",
+        "metric": f"{arch} train throughput (batch {BATCH}/chip, bf16)",
         "value": round(img_per_sec_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec_chip / V100_TF_BASELINE_IMG_PER_SEC, 3),

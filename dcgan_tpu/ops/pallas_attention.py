@@ -91,8 +91,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, tk):
     m, l, acc = lax.fori_loop(0, n_k, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     # log-sum-exp per row — the single vector the backward needs to
-    # reconstruct p tiles without storing them
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # reconstruct p tiles without storing them. Kept [S, 1] (not [S]):
+    # Mosaic requires block last-two dims (8, 128)-divisible or full, which
+    # a trailing singleton satisfies and a flat [B, S] block cannot.
+    lse_ref[0] = m + jnp.log(l)
 
 
 def _fwd_impl(q, k, v, scale):
@@ -106,9 +108,9 @@ def _fwd_impl(q, k, v, scale):
                   pl.BlockSpec((1, S, dk), lambda b, i: (b, 0, 0)),
                   pl.BlockSpec((1, S, dv), lambda b, i: (b, 0, 0))],
         out_specs=(pl.BlockSpec((1, tq, dv), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, tq), lambda b, i: (b, i))),
+                   pl.BlockSpec((1, tq, 1), lambda b, i: (b, i, 0))),
         out_shape=(jax.ShapeDtypeStruct((B, S, dv), jnp.float32),
-                   jax.ShapeDtypeStruct((B, S), jnp.float32)),
+                   jax.ShapeDtypeStruct((B, S, 1), jnp.float32)),
         interpret=_interpret(),
     )(q, k, v)
     return out, lse
@@ -122,8 +124,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                scale, tk):
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0]                                     # [TQ, 1]
+    delta = delta_ref[0]                                 # [TQ, 1]
     tq, dk = q.shape
     n_k = k_ref.shape[1] // tk
 
@@ -155,8 +157,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc, dv_acc = carry
         q = q_ref[0, pl.ds(i * tq, tq), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(i * tq, tq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * tq, tq)][:, None]
-        delta = delta_ref[0, pl.ds(i * tq, tq)][:, None]
+        lse = lse_ref[0, pl.ds(i * tq, tq), :]           # [TQ, 1]
+        delta = delta_ref[0, pl.ds(i * tq, tq), :]       # [TQ, 1]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)                             # [TQ, TK]
@@ -184,8 +186,9 @@ def _bwd_impl(scale, res, g):
     dv = v.shape[-1]
     tq, tk = _block(S), _block(S)
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
-    # one fused elementwise reduction, XLA handles it
-    delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1)          # [B, S]
+    # one fused elementwise reduction, XLA handles it. [B, S, 1] like lse.
+    delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1,
+                    keepdims=True)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, tk=tk),
@@ -194,8 +197,8 @@ def _bwd_impl(scale, res, g):
                   pl.BlockSpec((1, S, dk), lambda b, i: (b, 0, 0)),
                   pl.BlockSpec((1, S, dv), lambda b, i: (b, 0, 0)),
                   pl.BlockSpec((1, tq, dv), lambda b, i: (b, i, 0)),
-                  pl.BlockSpec((1, tq), lambda b, i: (b, i)),
-                  pl.BlockSpec((1, tq), lambda b, i: (b, i))],
+                  pl.BlockSpec((1, tq, 1), lambda b, i: (b, i, 0)),
+                  pl.BlockSpec((1, tq, 1), lambda b, i: (b, i, 0))],
         out_specs=pl.BlockSpec((1, tq, dk), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, S, dk), q.dtype),
         interpret=_interpret(),
@@ -208,8 +211,8 @@ def _bwd_impl(scale, res, g):
                   pl.BlockSpec((1, tk, dk), lambda b, j: (b, j, 0)),
                   pl.BlockSpec((1, tk, dv), lambda b, j: (b, j, 0)),
                   pl.BlockSpec((1, S, dv), lambda b, j: (b, 0, 0)),
-                  pl.BlockSpec((1, S), lambda b, j: (b, 0)),
-                  pl.BlockSpec((1, S), lambda b, j: (b, 0))],
+                  pl.BlockSpec((1, S, 1), lambda b, j: (b, 0, 0)),
+                  pl.BlockSpec((1, S, 1), lambda b, j: (b, 0, 0))],
         out_specs=(pl.BlockSpec((1, tk, dk), lambda b, j: (b, j, 0)),
                    pl.BlockSpec((1, tk, dv), lambda b, j: (b, j, 0))),
         out_shape=(jax.ShapeDtypeStruct((B, S, dk), k.dtype),
