@@ -39,7 +39,9 @@ class DataConfig:
     record_dtype: str = "float64"   # on-disk pixel dtype (image_input.py:48)
     min_after_dequeue: int = 10_776  # 10% of epoch (image_input.py:134-136)
     n_threads: int = 16             # (image_input.py:77)
-    prefetch_batches: int = 4
+    prefetch_batches: int = 8       # measured best on a 1-core host (+3-15%
+                                    # vs 4 — smooths bursty consumers like
+                                    # the scanned multi-step dispatch)
     seed: int = 0
     normalize: bool = True          # [-1,1]; False = strict reference parity
     feature_name: str = "image_raw"

@@ -27,14 +27,19 @@ from dcgan_tpu.data.pipeline import PythonLoader, list_shards  # noqa: E402
 from dcgan_tpu.data.synthetic import write_image_tfrecords  # noqa: E402
 
 
-def measure(loader, batch: int, *, warmup: int = 3, batches: int = 50
-            ) -> float:
+def measure(loader, batch: int, *, warmup: int = 3, batches: int = 50,
+            windows: int = 3) -> float:
+    """Best of `windows` measurement windows — host throughput swings 30%+
+    run-to-run on small shared machines; steady-state capability is the best
+    window, not the mean (same methodology as bench.py on the TPU side)."""
     for _ in range(warmup):
         loader.next()
-    t0 = time.perf_counter()
-    for _ in range(batches):
-        loader.next()
-    dt = time.perf_counter() - t0
+    dt = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(batches):
+            loader.next()
+        dt = min(dt, time.perf_counter() - t0)
     return batch * batches / dt
 
 
