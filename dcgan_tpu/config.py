@@ -49,6 +49,12 @@ class ModelConfig:
                                    # block executes as sequence-parallel ring
                                    # attention. 0 = off (reference parity: the
                                    # reference is pure conv)
+    spectral_norm: str = "none"    # "d": spectral-normalize every
+                                   # discriminator weight (SN-GAN,
+                                   # arXiv:1802.05957); "gd": both nets (the
+                                   # SAGAN recipe); "none" = reference parity.
+                                   # Power-iteration state is explicit, like
+                                   # BN moments (ops/spectral.py)
 
     def __post_init__(self):
         n = self.num_up_layers
@@ -62,6 +68,10 @@ class ModelConfig:
                 raise ValueError(
                     f"attn_res={self.attn_res} is not a feature-map "
                     f"resolution of this stack; choose one of {sorted(sites)}")
+        if self.spectral_norm not in ("none", "d", "gd"):
+            raise ValueError(
+                f"spectral_norm must be 'none', 'd', or 'gd', got "
+                f"{self.spectral_norm!r}")
 
     @property
     def num_up_layers(self) -> int:

@@ -23,7 +23,10 @@ Extensions beyond the reference (BASELINE.json configs):
   image for D;
 - attn_res > 0 inserts a SAGAN self-attention block (ops/attention.py) into
   both stacks at that feature-map resolution; `attn_mesh` routes it through
-  sequence-parallel ring attention when the spatial mesh shards image height.
+  sequence-parallel ring attention when the spatial mesh shards image height;
+- spectral_norm "d"/"gd" divides every D (and G) weight by its power-iterated
+  largest singular value each apply (ops/spectral.py) — the SN-GAN/SAGAN
+  Lipschitz control, with the iteration vectors as explicit sn_* state leaves.
 
 Params/state are plain nested dicts so `jax.tree_util` / optax / checkpointing
 all work without a framework dependency.
@@ -49,8 +52,50 @@ from dcgan_tpu.ops.layers import (
     lrelu,
 )
 from dcgan_tpu.ops.norm import batch_norm_apply, batch_norm_init
+from dcgan_tpu.ops.spectral import spectral_normalize, spectral_u_init
 
 Pytree = dict
+
+_ATTN_SUBLAYERS = ("query", "key", "value", "out")
+
+
+def _sn_state_init(key, params: Pytree, state: Pytree) -> None:
+    """Power-iteration u vectors for every weight in `params` (one level of
+    nesting for the attention block), written into `state` as sn_* leaves —
+    the explicit-state mirror of torch's hidden SN buffers."""
+    j = 0
+    for name in sorted(params):
+        p = params[name]
+        if "w" in p:
+            state[f"sn_{name}"] = spectral_u_init(
+                jax.random.fold_in(key, j), p["w"].shape[-1])
+            j += 1
+        elif name == "attn":
+            for sub in _ATTN_SUBLAYERS:
+                state[f"sn_attn_{sub}"] = spectral_u_init(
+                    jax.random.fold_in(key, j), p[sub]["w"].shape[-1])
+                j += 1
+
+
+def _sn_layer(params: Pytree, state: Pytree, new_state: Pytree, name: str,
+              train: bool) -> Pytree:
+    """params[name] with its weight spectrally normalized; advances the
+    layer's u into new_state (train=True) or carries it unchanged."""
+    w_sn, u = spectral_normalize(params[name]["w"], state[f"sn_{name}"],
+                                 train=train)
+    new_state[f"sn_{name}"] = u
+    return {**params[name], "w": w_sn}
+
+
+def _sn_attn(params_attn: Pytree, state: Pytree, new_state: Pytree,
+             train: bool) -> Pytree:
+    out = dict(params_attn)
+    for sub in _ATTN_SUBLAYERS:
+        w_sn, u = spectral_normalize(params_attn[sub]["w"],
+                                     state[f"sn_attn_{sub}"], train=train)
+        new_state[f"sn_attn_{sub}"] = u
+        out[sub] = {**params_attn[sub], "w": w_sn}
+    return out
 
 
 def _dtype(cfg: ModelConfig):
@@ -97,6 +142,10 @@ def generator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
         i = int(round(math.log2(cfg.attn_res / cfg.base_size)))
         ch = top_ch if i == 0 else cfg.gf_dim * (2 ** (k - 1 - i))
         params["attn"] = attn_init(keys[2 * k + 1], ch, dtype=dtype)
+    if cfg.spectral_norm == "gd":
+        # u keys derive from a fold_in of the net key so existing layer init
+        # streams (keys[...]) are untouched whatever the flag
+        _sn_state_init(jax.random.fold_in(key, 0x53AE), params, state)
     return params, state
 
 
@@ -122,6 +171,15 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
     k = cfg.num_up_layers
     cdt = _cdtype(cfg)
     new_state: Pytree = {}
+    sn = cfg.spectral_norm == "gd"
+
+    def layer(name):
+        return _sn_layer(params, state, new_state, name, train) if sn \
+            else params[name]
+
+    def attn_params():
+        return _sn_attn(params["attn"], state, new_state, train) if sn \
+            else params["attn"]
 
     if cfg.num_classes:
         if labels is None:
@@ -130,7 +188,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
         z = jnp.concatenate([z, onehot], axis=-1)
 
     top_ch = cfg.gf_dim * (2 ** (k - 1))
-    h = linear_apply(params["proj"], z.astype(cdt), compute_dtype=cdt)
+    h = linear_apply(layer("proj"), z.astype(cdt), compute_dtype=cdt)
     h = h.reshape(-1, cfg.base_size, cfg.base_size, top_ch)
     # BN + relu fused (one pass under use_pallas; XLA-fused otherwise)
     h, new_state["bn0"] = batch_norm_apply(
@@ -138,20 +196,20 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
         momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name,
         act="relu", use_pallas=cfg.use_pallas)
     if cfg.attn_res == cfg.base_size:
-        h = attn_apply(params["attn"], h, compute_dtype=cdt,
+        h = attn_apply(attn_params(), h, compute_dtype=cdt,
                        seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
     if capture is not None:
         capture["h0"] = h
 
     for i in range(1, k + 1):
-        h = deconv2d_apply(params[f"deconv{i}"], h, compute_dtype=cdt)
+        h = deconv2d_apply(layer(f"deconv{i}"), h, compute_dtype=cdt)
         if i < k:
             h, new_state[f"bn{i}"] = batch_norm_apply(
                 params[f"bn{i}"], state[f"bn{i}"], h, train=train,
                 momentum=cfg.bn_momentum, eps=cfg.bn_eps,
                 axis_name=axis_name, act="relu", use_pallas=cfg.use_pallas)
             if cfg.attn_res == cfg.base_size * (2 ** i):
-                h = attn_apply(params["attn"], h, compute_dtype=cdt,
+                h = attn_apply(attn_params(), h, compute_dtype=cdt,
                                seq_mesh=attn_mesh,
                                use_pallas=cfg.use_pallas)
             if capture is not None:
@@ -206,6 +264,8 @@ def discriminator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
         i = int(round(math.log2(cfg.output_size / cfg.attn_res))) - 1
         params["attn"] = attn_init(keys[2 * k], cfg.df_dim * (2 ** i),
                                    dtype=dtype)
+    if cfg.spectral_norm in ("d", "gd"):
+        _sn_state_init(jax.random.fold_in(key, 0x53AE), params, state)
     return params, state
 
 
@@ -224,6 +284,15 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
     k = cfg.num_up_layers
     cdt = _cdtype(cfg)
     new_state: Pytree = {}
+    sn = cfg.spectral_norm in ("d", "gd")
+
+    def layer(name):
+        return _sn_layer(params, state, new_state, name, train) if sn \
+            else params[name]
+
+    def attn_params():
+        return _sn_attn(params["attn"], state, new_state, train) if sn \
+            else params["attn"]
 
     h = image.astype(cdt)
     if cfg.num_classes:
@@ -235,7 +304,7 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
         h = jnp.concatenate([h, maps], axis=-1)
 
     for i in range(k):
-        h = conv2d_apply(params[f"conv{i}"], h, compute_dtype=cdt)
+        h = conv2d_apply(layer(f"conv{i}"), h, compute_dtype=cdt)
         if i > 0:
             # BN + lrelu fused (stage 0 keeps the reference's no-BN shape)
             h, new_state[f"bn{i}"] = batch_norm_apply(
@@ -246,13 +315,13 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
         else:
             h = lrelu(h, cfg.leak)
         if cfg.attn_res and cfg.attn_res == cfg.output_size >> (i + 1):
-            h = attn_apply(params["attn"], h, compute_dtype=cdt,
+            h = attn_apply(attn_params(), h, compute_dtype=cdt,
                            seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
         if capture is not None:
             capture[f"h{i}"] = h
 
     h = h.reshape(h.shape[0], -1)
-    logit = linear_apply(params["head"], h, compute_dtype=cdt)
+    logit = linear_apply(layer("head"), h, compute_dtype=cdt)
     logit = logit.astype(jnp.float32)
     if capture is not None:
         capture["logit"] = logit

@@ -89,12 +89,15 @@ def wgan_gp(**overrides) -> TrainConfig:
 def sagan64(**overrides) -> TrainConfig:
     """Self-attention GAN on 64x64: DCGAN stacks with attention at 32x32.
 
-    The canonical SAGAN recipe (Zhang et al. 2018): hinge loss, TTUR
-    (d_lr 4e-4 / g_lr 1e-4), beta1=0, generator weight EMA. Beyond-reference
-    model family; under `--mesh_spatial` the attention runs as
-    sequence-parallel ring attention (ops/attention.py).
+    The canonical SAGAN recipe (Zhang et al. 2018): hinge loss, spectral
+    norm on both nets, TTUR (d_lr 4e-4 / g_lr 1e-4), beta1=0, generator
+    weight EMA. Beyond-reference model family; under `--mesh_spatial` the
+    attention runs as sequence-parallel ring attention (ops/attention.py).
+    One documented divergence: G normalization is the reference's plain
+    (synced) BatchNorm, not the paper's conditional BN.
     """
-    cfg = _build(ModelConfig(output_size=64, attn_res=32), MeshConfig(),
+    cfg = _build(ModelConfig(output_size=64, attn_res=32,
+                             spectral_norm="gd"), MeshConfig(),
                  batch_size=64, loss="hinge", beta1=0.0,
                  d_learning_rate=4e-4, g_learning_rate=1e-4,
                  g_ema_decay=0.999)

@@ -57,6 +57,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help=">0 inserts SAGAN self-attention into both stacks at "
                         "this feature-map resolution (ring attention under "
                         "--mesh_spatial); 0 = off")
+    p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
+                   default="none",
+                   help="spectral-normalize discriminator (d) or both nets' "
+                        "(gd) weights — SN-GAN / SAGAN Lipschitz control")
     # data (image_train.py:19-26)
     p.add_argument("--dataset", default="celebA")
     p.add_argument("--data_dir", default="train")
@@ -159,6 +163,7 @@ _FLAG_FIELDS = {
     "df_dim": ("model", "df_dim"), "num_classes": ("model", "num_classes"),
     "use_pallas": ("model", "use_pallas"),
     "attn_res": ("model", "attn_res"),
+    "spectral_norm": ("model", "spectral_norm"),
     "mesh_data": ("mesh", "data"), "mesh_model": ("mesh", "model"),
     "mesh_spatial": ("mesh", "spatial"), "backend": ("", "backend"),
     "mesh_shard_opt": ("mesh", "shard_opt"),

@@ -47,6 +47,7 @@ class TestPresets:
     def test_sagan64_recipe(self):
         cfg = get_preset("sagan64")
         assert cfg.model.attn_res == 32
+        assert cfg.model.spectral_norm == "gd"
         assert cfg.loss == "hinge" and cfg.beta1 == 0.0
         assert cfg.d_learning_rate == 4e-4 and cfg.g_learning_rate == 1e-4
         assert cfg.g_ema_decay == 0.999
