@@ -1,12 +1,14 @@
-"""FID eval CLI: score a trained checkpoint against a dataset.
+"""Eval CLI: score a trained checkpoint against a dataset (FID; KID with
+--kid, from the same feature pass).
 
     python -m dcgan_tpu.evals --checkpoint_dir ckpt --data_dir /data/celeba
-    python -m dcgan_tpu.evals --checkpoint_dir ckpt --synthetic \
+    python -m dcgan_tpu.evals --checkpoint_dir ckpt --synthetic --kid \
         --num_samples 1024 --platform cpu        # smoke run
 
-Prints one JSON line: {"fid": ..., "num_samples": ..., "feature_dim": ...}.
-There is no counterpart in the reference — its only eval was the human
-eyeballing the sample grids (SURVEY.md §4).
+Prints one JSON line: {"fid": ..., "num_samples": ..., "feature_dim": ...,
+("kid": ..., "kid_std": ...,) "step": ...}. There is no counterpart in the
+reference — its only eval was the human eyeballing the sample grids
+(SURVEY.md §4).
 """
 
 from __future__ import annotations
@@ -32,6 +34,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gf_dim", type=int, default=64)
     p.add_argument("--df_dim", type=int, default=64)
     p.add_argument("--num_classes", type=int, default=0)
+    p.add_argument("--attn_res", type=int, default=0,
+                   help="match the checkpoint's attention config")
+    p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
+                   default="none",
+                   help="match the checkpoint's spectral-norm config")
+    p.add_argument("--kid", action="store_true",
+                   help="also report KID (subset-averaged unbiased MMD^2) "
+                        "from the same feature pass")
+    p.add_argument("--kid_subset_size", type=int, default=1000)
+    p.add_argument("--kid_subsets", type=int, default=100)
+    p.add_argument("--kid_pool", type=int, default=10_000,
+                   help="per-side reservoir cap for KID features; raise to "
+                        "num_samples for full-set KID (memory: pool*D*4 "
+                        "bytes per side)")
     p.add_argument("--feature_npz", default=None,
                    help="optional trained embedder weights (evals/features.py)")
     p.add_argument("--use_ema", action="store_true",
@@ -62,7 +78,9 @@ def main(argv: Optional[List[str]] = None) -> None:
     cfg = TrainConfig(
         model=ModelConfig(output_size=args.output_size, c_dim=args.c_dim,
                           z_dim=args.z_dim, gf_dim=args.gf_dim,
-                          df_dim=args.df_dim, num_classes=args.num_classes),
+                          df_dim=args.df_dim, num_classes=args.num_classes,
+                          attn_res=args.attn_res,
+                          spectral_norm=args.spectral_norm),
         batch_size=args.batch_size,
         checkpoint_dir=args.checkpoint_dir,
         # any value > 0 makes sample() read state["ema_gen"]
@@ -103,7 +121,9 @@ def main(argv: Optional[List[str]] = None) -> None:
         sample_fn, data, image_size=args.output_size, c_dim=args.c_dim,
         z_dim=args.z_dim, num_samples=args.num_samples,
         batch_size=args.batch_size, num_classes=args.num_classes,
-        seed=args.seed, feature_fn=feature_fn, feature_dim=feature_dim)
+        seed=args.seed, feature_fn=feature_fn, feature_dim=feature_dim,
+        kid=args.kid, kid_subset_size=args.kid_subset_size,
+        kid_subsets=args.kid_subsets, kid_pool_size=args.kid_pool)
     result["step"] = step
     print(json.dumps(result))
 

@@ -1,11 +1,13 @@
-"""The FID eval job: stream real-data and generator features into statistics,
-score the Fréchet distance (BASELINE.md north star: FID-50k parity).
+"""The eval job: stream real-data and generator features once, score FID
+(BASELINE.md north star: FID-50k parity) and optionally KID from the same
+pass.
 
 Layout mirrors the training driver: the sampler is the mesh-sharded
 `ParallelTrain.sample` (generation fans out over the data axis), features are
-extracted on device batch-by-batch, and only [D] / [D, D] statistics live on
-host. 50k samples at batch 256 is ~200 device round trips of [B, D] floats —
-negligible next to generation itself.
+extracted on device batch-by-batch, and only [D] / [D, D] moment statistics —
+plus a bounded KID reservoir when enabled — live on host. 50k samples at
+batch 256 is ~200 device round trips of [B, D] floats — negligible next to
+generation itself.
 """
 
 from __future__ import annotations
@@ -17,18 +19,23 @@ import numpy as np
 
 from dcgan_tpu.evals.features import FeatureFn, make_random_feature_fn
 from dcgan_tpu.evals.fid import StreamingStats, frechet_distance
+from dcgan_tpu.evals.kid import FeaturePool, kid_score
 
 
 def stats_from_batches(feature_fn: FeatureFn, batches: Iterable,
-                       num_examples: int, feature_dim: int) -> StreamingStats:
+                       num_examples: int, feature_dim: int,
+                       pool: Optional[FeaturePool] = None) -> StreamingStats:
     """Fold image batches ([B,H,W,C] in [-1,1]) into feature statistics until
     `num_examples` have been consumed; the last batch is trimmed to land
-    exactly on the target count."""
+    exactly on the target count. `pool`, if given, reservoir-samples the same
+    features for KID."""
     stats = StreamingStats(feature_dim)
     for batch in batches:
         take = min(int(batch.shape[0]), num_examples - stats.n)
         feats = jax.device_get(feature_fn(batch[:take]))
         stats.update(feats)
+        if pool is not None:
+            pool.update(feats)
         if stats.n >= num_examples:
             break
     if stats.n < num_examples:
@@ -39,8 +46,8 @@ def stats_from_batches(feature_fn: FeatureFn, batches: Iterable,
 
 def generator_stats(sample_fn: Callable, feature_fn: FeatureFn,
                     feature_dim: int, *, num_samples: int, batch_size: int,
-                    z_dim: int, seed: int = 0,
-                    num_classes: int = 0) -> StreamingStats:
+                    z_dim: int, seed: int = 0, num_classes: int = 0,
+                    pool: Optional[FeaturePool] = None) -> StreamingStats:
     """Stream `num_samples` generated images into feature statistics.
 
     `sample_fn(z[, labels]) -> images` is the EMA-stat sampler path
@@ -62,6 +69,8 @@ def generator_stats(sample_fn: Callable, feature_fn: FeatureFn,
         take = min(batch_size, num_samples - stats.n)
         feats = jax.device_get(feature_fn(images[:take]))
         stats.update(feats)
+        if pool is not None:
+            pool.update(feats)
         i += 1
     return stats
 
@@ -71,23 +80,44 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
                 num_samples: int = 50_000, batch_size: int = 256,
                 num_classes: int = 0, seed: int = 0,
                 feature_fn: Optional[FeatureFn] = None,
-                feature_dim: Optional[int] = None) -> dict:
-    """End-to-end FID: returns {"fid", "num_samples", "feature_dim"}.
+                feature_dim: Optional[int] = None,
+                kid: bool = False, kid_subset_size: int = 1000,
+                kid_subsets: int = 100,
+                kid_pool_size: int = 10_000) -> dict:
+    """End-to-end scoring: returns {"fid", "num_samples", "feature_dim"} and,
+    with kid=True, {"kid", "kid_std"} from the SAME feature pass (a bounded
+    reservoir of features feeds the subset-averaged unbiased-MMD estimator —
+    evals/kid.py).
 
     With feature_fn=None the fixed-seed random embedder is used — scores are
-    then comparable across runs/processes but are surrogate-FID, not
-    Inception-FID (see evals/features.py).
+    then comparable across runs/processes but are surrogate scores, not
+    Inception ones (see evals/features.py).
     """
     if feature_fn is None:
         feature_fn, feature_dim = make_random_feature_fn(image_size, c_dim)
     elif feature_dim is None:
         raise ValueError("feature_dim required with a custom feature_fn")
 
+    real_pool = FeaturePool(feature_dim, kid_pool_size, seed=seed) \
+        if kid else None
+    fake_pool = FeaturePool(feature_dim, kid_pool_size, seed=seed + 1) \
+        if kid else None
     real = stats_from_batches(feature_fn, data_batches, num_samples,
-                              feature_dim)
+                              feature_dim, pool=real_pool)
     fake = generator_stats(sample_fn, feature_fn, feature_dim,
                            num_samples=num_samples, batch_size=batch_size,
-                           z_dim=z_dim, seed=seed, num_classes=num_classes)
+                           z_dim=z_dim, seed=seed, num_classes=num_classes,
+                           pool=fake_pool)
     fid = frechet_distance(*real.finalize(), *fake.finalize())
-    return {"fid": fid, "num_samples": num_samples,
-            "feature_dim": feature_dim}
+    out = {"fid": fid, "num_samples": num_samples,
+           "feature_dim": feature_dim}
+    if kid:
+        mean, std = kid_score(real_pool.features(), fake_pool.features(),
+                              subset_size=kid_subset_size,
+                              num_subsets=kid_subsets, seed=seed)
+        out["kid"] = mean
+        out["kid_std"] = std
+        # the score is computed on at most this many reservoir-sampled
+        # features per side — recorded so KID numbers are comparable
+        out["kid_pool"] = min(kid_pool_size, num_samples)
+    return out

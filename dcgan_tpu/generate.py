@@ -49,6 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--gf_dim", type=int, default=None)
     p.add_argument("--df_dim", type=int, default=None)
     p.add_argument("--num_classes", type=int, default=None)
+    p.add_argument("--attn_res", type=int, default=None,
+                   help="match the checkpoint's attention config "
+                        "(presets supply it; explicit flag overrides)")
+    p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
+                   default=None,
+                   help="match the checkpoint's spectral-norm config")
     p.add_argument("--class_id", type=int, default=None,
                    help="conditional models: generate only this class "
                         "(default: cycle all classes)")
@@ -62,7 +68,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 _MODEL_FLAGS = ("output_size", "c_dim", "z_dim", "gf_dim", "df_dim",
-                "num_classes")
+                "num_classes", "attn_res", "spectral_norm")
 
 
 def _model_config(args: argparse.Namespace):

@@ -42,9 +42,11 @@ def spectral_normalize(w: jax.Array, u: jax.Array, *, train: bool,
 
     `w` is any-rank weight; its last axis is the output dim ([in, out]
     linear, [h, w, in, out] conv — both reshape to [N, out] for the power
-    iteration, torch's convention transposed). train=True advances the
-    iteration and returns the updated u; train=False estimates sigma from
-    the stored u without moving it (the BN train/eval contract).
+    iteration, torch's convention transposed). Both modes run `n_iter`
+    power-iteration steps from the stored u to estimate sigma; train=True
+    persists the advanced u into the returned state, train=False returns
+    the stored u unchanged (the BN train/eval contract — repeated eval
+    applies are idempotent).
     """
     out_dim = w.shape[-1]
     w2d = w.astype(jnp.float32).reshape(-1, out_dim)     # [N, out]

@@ -1,6 +1,6 @@
-"""FID rig: streaming-stat correctness vs numpy, Fréchet closed forms,
-feature-extractor determinism, and the end-to-end eval job (SURVEY.md §7
-phase 8)."""
+"""FID/KID rig: streaming-stat correctness vs numpy, Fréchet closed forms,
+KID estimator properties, feature-extractor determinism, and the end-to-end
+eval job (SURVEY.md §7 phase 8)."""
 
 import jax
 import jax.numpy as jnp
@@ -125,6 +125,78 @@ def _image_stream(seed, n_per_batch, size, shift=0.0):
         yield np.clip(rng.normal(loc=shift, scale=0.3,
                                  size=(n_per_batch, size, size, 3)),
                       -1, 1).astype(np.float32)
+
+
+class TestKID:
+    def test_same_distribution_near_zero_unbiased(self):
+        from dcgan_tpu.evals.kid import mmd2_unbiased
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(400, 16))
+        y = rng.normal(size=(400, 16))
+        z = rng.normal(loc=1.0, size=(400, 16))
+        same = mmd2_unbiased(x, y)
+        diff = mmd2_unbiased(x, z)
+        # unbiased estimator: near zero (can be slightly negative) for same
+        # distribution, clearly positive under a mean shift
+        assert abs(same) < 0.1
+        assert diff > 10 * abs(same)
+
+    def test_kid_score_subset_averaging(self):
+        from dcgan_tpu.evals.kid import kid_score
+
+        rng = np.random.default_rng(1)
+        real = rng.normal(size=(600, 8))
+        fake = rng.normal(loc=0.5, size=(600, 8))
+        mean, std = kid_score(real, fake, subset_size=100, num_subsets=20,
+                              seed=0)
+        assert mean > 0 and std >= 0
+        mean2, _ = kid_score(real, fake, subset_size=100, num_subsets=20,
+                             seed=0)
+        assert mean == mean2  # deterministic under a fixed seed
+
+    def test_feature_pool_reservoir_uniformity(self):
+        from dcgan_tpu.evals.kid import FeaturePool
+
+        pool = FeaturePool(1, capacity=64, seed=0)
+        # stream 0..999 as 1-dim features; reservoir mean ~ stream mean
+        for start in range(0, 1000, 50):
+            pool.update(np.arange(start, start + 50,
+                                  dtype=np.float32)[:, None])
+        assert pool.features().shape == (64, 1)
+        assert pool.n_seen == 1000
+        assert abs(float(pool.features().mean()) - 499.5) < 120  # ~3 sigma
+
+    def test_feature_pool_merge_counts(self):
+        from dcgan_tpu.evals.kid import FeaturePool
+
+        a = FeaturePool(2, capacity=16, seed=0)
+        b = FeaturePool(2, capacity=16, seed=1)
+        a.update(np.zeros((10, 2), np.float32))
+        b.update(np.ones((30, 2), np.float32))
+        a.merge(b)
+        assert a.n_seen == 40
+        # union sample leans toward the larger stream
+        assert float(a.features().mean()) > 0.5
+
+    def test_compute_fid_with_kid_single_pass(self):
+        from dcgan_tpu.config import ModelConfig
+        from dcgan_tpu.models import gan_init, sampler_apply
+
+        mcfg = ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                           compute_dtype="float32")
+        params, bn = gan_init(jax.random.key(0), mcfg)
+
+        def sample_fn(z):
+            return sampler_apply(params["gen"], bn["gen"], z, cfg=mcfg)
+
+        result = compute_fid(sample_fn, _image_stream(0, 64, 16),
+                             image_size=16, z_dim=mcfg.z_dim,
+                             num_samples=128, batch_size=64, kid=True,
+                             kid_subset_size=64, kid_subsets=5)
+        assert np.isfinite(result["kid"]) and result["kid_std"] >= 0
+        # untrained G vs gaussian reals: clearly nonzero
+        assert result["kid"] > 0
 
 
 class TestEvalJob:
