@@ -49,6 +49,11 @@ class ModelConfig:
                                    # block executes as sequence-parallel ring
                                    # attention. 0 = off (reference parity: the
                                    # reference is pure conv)
+    attn_heads: int = 1            # heads for the attention block (1 = the
+                                   # SAGAN paper's single head). Apply-time
+                                   # split of the same projections — param
+                                   # shapes and checkpoints are head-count
+                                   # independent (ops/attention.py)
     spectral_norm: str = "none"    # "d": spectral-normalize every
                                    # discriminator weight (SN-GAN,
                                    # arXiv:1802.05957); "gd": both nets (the
@@ -72,6 +77,9 @@ class ModelConfig:
             raise ValueError(
                 f"spectral_norm must be 'none', 'd', or 'gd', got "
                 f"{self.spectral_norm!r}")
+        if self.attn_heads < 1:
+            raise ValueError(
+                f"attn_heads must be >= 1, got {self.attn_heads}")
 
     @property
     def num_up_layers(self) -> int:

@@ -36,6 +36,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_classes", type=int, default=0)
     p.add_argument("--attn_res", type=int, default=0,
                    help="match the checkpoint's attention config")
+    p.add_argument("--attn_heads", type=int, default=1,
+                   help="match the checkpoint's attention head count (an "
+                        "apply-time split — a mismatch loads cleanly but "
+                        "evaluates a different network)")
     p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
                    default="none",
                    help="match the checkpoint's spectral-norm config")
@@ -80,6 +84,7 @@ def main(argv: Optional[List[str]] = None) -> None:
                           z_dim=args.z_dim, gf_dim=args.gf_dim,
                           df_dim=args.df_dim, num_classes=args.num_classes,
                           attn_res=args.attn_res,
+                          attn_heads=args.attn_heads,
                           spectral_norm=args.spectral_norm),
         batch_size=args.batch_size,
         checkpoint_dir=args.checkpoint_dir,

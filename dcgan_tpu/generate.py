@@ -52,6 +52,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attn_res", type=int, default=None,
                    help="match the checkpoint's attention config "
                         "(presets supply it; explicit flag overrides)")
+    p.add_argument("--attn_heads", type=int, default=None,
+                   help="match the checkpoint's attention head count")
     p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
                    default=None,
                    help="match the checkpoint's spectral-norm config")
@@ -68,7 +70,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 _MODEL_FLAGS = ("output_size", "c_dim", "z_dim", "gf_dim", "df_dim",
-                "num_classes", "attn_res", "spectral_norm")
+                "num_classes", "attn_res", "attn_heads", "spectral_norm")
 
 
 def _model_config(args: argparse.Namespace):

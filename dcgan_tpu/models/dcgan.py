@@ -197,6 +197,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
         act="relu", use_pallas=cfg.use_pallas)
     if cfg.attn_res == cfg.base_size:
         h = attn_apply(attn_params(), h, compute_dtype=cdt,
+                       num_heads=cfg.attn_heads,
                        seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
     if capture is not None:
         capture["h0"] = h
@@ -210,6 +211,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
                 axis_name=axis_name, act="relu", use_pallas=cfg.use_pallas)
             if cfg.attn_res == cfg.base_size * (2 ** i):
                 h = attn_apply(attn_params(), h, compute_dtype=cdt,
+                               num_heads=cfg.attn_heads,
                                seq_mesh=attn_mesh,
                                use_pallas=cfg.use_pallas)
             if capture is not None:
@@ -316,6 +318,7 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
             h = lrelu(h, cfg.leak)
         if cfg.attn_res and cfg.attn_res == cfg.output_size >> (i + 1):
             h = attn_apply(attn_params(), h, compute_dtype=cdt,
+                           num_heads=cfg.attn_heads,
                            seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
         if capture is not None:
             capture[f"h{i}"] = h

@@ -134,10 +134,16 @@ def _project(params: Pytree, x: jax.Array, cdt) -> Tuple[jax.Array, ...]:
 
 
 def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
-               seq_mesh=None, seq_axis: str = "model",
+               num_heads: int = 1, seq_mesh=None, seq_axis: str = "model",
                batch_axis: str = "data",
                use_pallas: bool = False) -> jax.Array:
     """x [B,H,W,C] -> x + gamma * attention(x) (same shape/dtype).
+
+    num_heads > 1 splits the existing query/key/value projections into heads
+    (folded into the batch dim around the attention proper, so every
+    execution form below — dense, flash, ring — is head-agnostic). Head
+    count is an apply-time knob: parameter shapes do not change, so the same
+    checkpoint serves any divisor head count.
 
     seq_mesh=None: attention over the full flattened H*W sequence (under a
     data-parallel jit the batch dim shards and nothing else changes).
@@ -157,6 +163,12 @@ def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
     cdt = compute_dtype
     seq = x.reshape(B, H * W, C)
     q, k, v = _project(params, seq, cdt)
+    if num_heads > 1:
+        if q.shape[-1] % num_heads or v.shape[-1] % num_heads:
+            raise ValueError(
+                f"num_heads={num_heads} does not divide the projection dims "
+                f"(qk {q.shape[-1]}, v {v.shape[-1]})")
+        q, k, v = (_split_heads(t, num_heads) for t in (q, k, v))
     scale = 1.0 / (q.shape[-1] ** 0.5)
 
     if seq_mesh is not None and seq_mesh.shape[seq_axis] > 1:
@@ -177,6 +189,22 @@ def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
     else:
         out = full_attention(q, k, v, scale=scale)
 
+    if num_heads > 1:
+        out = _merge_heads(out, num_heads)
     out = linear_apply(params["out"], out.astype(v.dtype), compute_dtype=cdt)
     gamma = params["gamma"].astype(x.dtype)
     return x + gamma * out.reshape(B, H, W, C).astype(x.dtype)
+
+
+def _split_heads(t: jax.Array, h: int) -> jax.Array:
+    """[B, S, h*d] -> [B*h, S, d] (heads ride the batch dim)."""
+    B, S, D = t.shape
+    return t.reshape(B, S, h, D // h).transpose(0, 2, 1, 3) \
+        .reshape(B * h, S, D // h)
+
+
+def _merge_heads(t: jax.Array, h: int) -> jax.Array:
+    """[B*h, S, d] -> [B, S, h*d]."""
+    Bh, S, d = t.shape
+    return t.reshape(Bh // h, h, S, d).transpose(0, 2, 1, 3) \
+        .reshape(Bh // h, S, h * d)

@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help=">0 inserts SAGAN self-attention into both stacks at "
                         "this feature-map resolution (ring attention under "
                         "--mesh_spatial); 0 = off")
+    p.add_argument("--attn_heads", type=int, default=1,
+                   help="attention heads (1 = SAGAN paper; apply-time split, "
+                        "checkpoint-compatible across head counts)")
     p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
                    default="none",
                    help="spectral-normalize discriminator (d) or both nets' "
@@ -163,6 +166,7 @@ _FLAG_FIELDS = {
     "df_dim": ("model", "df_dim"), "num_classes": ("model", "num_classes"),
     "use_pallas": ("model", "use_pallas"),
     "attn_res": ("model", "attn_res"),
+    "attn_heads": ("model", "attn_heads"),
     "spectral_norm": ("model", "spectral_norm"),
     "mesh_data": ("mesh", "data"), "mesh_model": ("mesh", "model"),
     "mesh_spatial": ("mesh", "spatial"), "backend": ("", "backend"),

@@ -139,6 +139,30 @@ class TestAttnBlock:
         with pytest.raises(ValueError, match="does not shard"):
             attn_apply(params, x, seq_mesh=ring_mesh(8))
 
+    def test_multihead_all_paths_agree(self):
+        """Heads fold into the batch dim, so dense / flash / ring must stay
+        mutually exact with num_heads > 1 (same params — the head count is an
+        apply-time split)."""
+        params = attn_init(jax.random.key(0), 32)
+        params = dict(params, gamma=jnp.asarray(0.6))
+        x = jax.random.normal(jax.random.key(1), (4, 8, 8, 32))
+        dense = attn_apply(params, x, num_heads=2)
+        ringy = attn_apply(params, x, num_heads=2, seq_mesh=ring_mesh(4))
+        fused = attn_apply(params, x, num_heads=2, use_pallas=True)
+        np.testing.assert_allclose(np.asarray(ringy), np.asarray(dense),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                                   atol=1e-5)
+        # heads=2 is a different function than heads=1
+        single = attn_apply(params, x, num_heads=1)
+        assert np.abs(np.asarray(dense) - np.asarray(single)).max() > 1e-4
+
+    def test_multihead_rejects_indivisible(self):
+        params = attn_init(jax.random.key(0), 16)  # qk dim 2, v dim 8
+        x = jax.random.normal(jax.random.key(1), (2, 8, 8, 16))
+        with pytest.raises(ValueError, match="does not divide"):
+            attn_apply(params, x, num_heads=3)
+
 
 class TestModelWiring:
     def test_attn_res_validation(self):
