@@ -54,6 +54,13 @@ class ModelConfig:
                                    # split of the same projections — param
                                    # shapes and checkpoints are head-count
                                    # independent (ops/attention.py)
+    attn_seq_strategy: str = "ring"  # sequence-parallel execution under a
+                                     # spatial mesh: "ring" (ppermute k/v,
+                                     # any head count) | "ulysses" (two
+                                     # all_to_alls; attn_heads must be
+                                     # divisible by the model-axis size —
+                                     # arXiv:2309.14509). Exact either way;
+                                     # a pure execution knob
     spectral_norm: str = "none"    # "d": spectral-normalize every
                                    # discriminator weight (SN-GAN,
                                    # arXiv:1802.05957); "gd": both nets (the
@@ -80,6 +87,10 @@ class ModelConfig:
         if self.attn_heads < 1:
             raise ValueError(
                 f"attn_heads must be >= 1, got {self.attn_heads}")
+        if self.attn_seq_strategy not in ("ring", "ulysses"):
+            raise ValueError(
+                f"attn_seq_strategy must be 'ring' or 'ulysses', got "
+                f"{self.attn_seq_strategy!r}")
 
     @property
     def num_up_layers(self) -> int:

@@ -198,6 +198,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
     if cfg.attn_res == cfg.base_size:
         h = attn_apply(attn_params(), h, compute_dtype=cdt,
                        num_heads=cfg.attn_heads,
+                       seq_strategy=cfg.attn_seq_strategy,
                        seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
     if capture is not None:
         capture["h0"] = h
@@ -212,6 +213,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
             if cfg.attn_res == cfg.base_size * (2 ** i):
                 h = attn_apply(attn_params(), h, compute_dtype=cdt,
                                num_heads=cfg.attn_heads,
+                               seq_strategy=cfg.attn_seq_strategy,
                                seq_mesh=attn_mesh,
                                use_pallas=cfg.use_pallas)
             if capture is not None:
@@ -319,6 +321,7 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
         if cfg.attn_res and cfg.attn_res == cfg.output_size >> (i + 1):
             h = attn_apply(attn_params(), h, compute_dtype=cdt,
                            num_heads=cfg.attn_heads,
+                           seq_strategy=cfg.attn_seq_strategy,
                            seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
         if capture is not None:
             capture[f"h{i}"] = h

@@ -1,32 +1,42 @@
-"""Self-attention over the spatial sequence, with a ring-attention path for
-sequence-parallel execution.
+"""Self-attention over the spatial sequence, with two sequence-parallel
+execution strategies (ring and all-to-all/Ulysses).
 
 The reference has no attention anywhere — it is a pure-conv DCGAN whose
 largest spatial extent is 64x64 (distriubted_model.py:7,83-128), and SURVEY.md
 §2.5 records sequence/context parallelism as structurally absent. This module
 is the framework's first-class long-context machinery anyway: images flatten
 to a sequence of H*W spatial positions, a SAGAN-style self-attention block
-(Zhang et al. 2018, arXiv:1805.08318) attends over that sequence, and when the
-sequence is sharded over a mesh axis the attention runs as a **ring**:
-each device keeps its query block resident and rotates key/value blocks around
-the axis with `lax.ppermute`, folding each incoming block into a numerically
-stable online softmax (the blockwise/flash recurrence of Ring Attention,
-arXiv:2310.01889). Peak memory per device is O(S_local^2) instead of O(S^2),
-no device ever materializes the full sequence, and the transfers ride ICI
-neighbor links.
+(Zhang et al. 2018, arXiv:1805.08318, optionally multi-head) attends over
+that sequence, and when the sequence is sharded over a mesh axis the
+attention runs in one of two explicit-collective forms:
+
+- **ring** (`ring_attention`, arXiv:2310.01889): each device keeps its query
+  block resident and rotates key/value blocks around the axis with
+  `lax.ppermute`, folding each incoming block into a numerically stable
+  online softmax. n-1 neighbor hops on ICI; peak memory O(S_local^2); no
+  device ever materializes the full sequence; any head count.
+- **ulysses** (`ulysses_attention`, arXiv:2309.14509): one `lax.all_to_all`
+  trades sequence sharding for head sharding, each device runs ordinary (or
+  flash) attention over the FULL sequence for its share of heads, a second
+  all_to_all trades back. Two collectives total; needs num_heads divisible
+  by the axis size; per-device memory is bounded by the flash path, not the
+  strategy.
 
 Design notes:
 - `attn_apply` is identity at initialization: the residual gate `gamma` starts
   at 0 (the SAGAN recipe), so inserting the block into a DCGAN stack does not
   perturb the reference dynamics until training moves gamma.
 - Projections are 1x1 convs expressed as channel matmuls: query/key to C/8,
-  value to C/2, output back to C — the SAGAN channel plan.
-- Logits are scaled by 1/sqrt(d_k) (standard scaled dot-product; SAGAN's paper
-  omits the scale — documented divergence, it only re-scales what gamma=0
-  already gates) and accumulated in float32 regardless of compute dtype.
-- `ring_attention` is exact: full-vs-ring equivalence is asserted to f32
-  tolerance in tests/test_attention.py on an 8-virtual-device mesh, gradients
-  included (ppermute and the scan recurrence are differentiable as-is).
+  value to C/2, output back to C — the SAGAN channel plan. Heads are an
+  apply-time split of the same projections (checkpoint-compatible).
+- Logits are scaled by 1/sqrt(d_head) (standard scaled dot-product; SAGAN's
+  paper omits the scale — documented divergence, it only re-scales what
+  gamma=0 already gates) and accumulated in float32 regardless of compute
+  dtype.
+- Both strategies are exact: equivalence against dense attention (and each
+  other) is asserted to f32 tolerance in tests/test_attention.py on an
+  8-virtual-device mesh, gradients included (ppermute, all_to_all, and the
+  scan recurrence are differentiable as-is).
 """
 
 from __future__ import annotations
@@ -126,6 +136,59 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     return acc / l[..., None]
 
 
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      axis_name: str, n_shards: int, num_heads: int,
+                      scale: float, use_pallas: bool = False) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses, arXiv:2309.14509).
+
+    Per-device blocks q,k,v: [B, S_local, h*d] sharded on the sequence. One
+    `all_to_all` re-shards from sequence-split to head-split — each device
+    then holds the FULL sequence for h/n_shards heads and runs ordinary
+    attention locally — and a second all_to_all restores sequence sharding.
+    Two collectives total, each moving the activations once, vs the ring's
+    n-1 k/v hops: better when heads divide nicely and the fabric does fast
+    all-to-alls; the ring wins when h < n or per-hop overlap matters. Both
+    are exact; tests pin them against dense attention and each other.
+    """
+    if num_heads % n_shards:
+        raise ValueError(
+            f"ulysses needs num_heads ({num_heads}) divisible by the "
+            f"sequence-parallel axis ({n_shards}); use the ring strategy "
+            "or adjust attn_heads")
+    B, S_loc, _ = q.shape
+
+    def to_heads(t):
+        # [B, S_loc, h, d] --all_to_all--> [B, S_loc*n, h/n, d]
+        t = t.reshape(B, S_loc, num_heads, t.shape[-1] // num_heads)
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    S = S_loc * n_shards
+    h_loc = num_heads // n_shards
+
+    def fold(t):  # heads into batch for the local attention
+        return t.transpose(0, 2, 1, 3).reshape(B * h_loc, S, t.shape[-1])
+
+    if use_pallas:
+        # local attention over the full sequence is exactly the regime the
+        # flash kernels exist for (no [S, S] score matrix per device)
+        from dcgan_tpu.ops.pallas_attention import flash_attention
+
+        out = flash_attention(fold(qh), fold(kh), fold(vh), scale)
+    else:
+        out = full_attention(fold(qh), fold(kh), fold(vh), scale=scale)
+    # downcast BEFORE the return collective: the f32 accumulation is local,
+    # and shipping f32 under a bf16 compute dtype would double the bytes of
+    # one of the strategy's two activation moves
+    out = out.astype(v.dtype)
+    out = out.reshape(B, h_loc, S, -1).transpose(0, 2, 1, 3)
+    # [B, S, h/n, dv] --all_to_all--> [B, S_loc, h, dv], heads re-merged
+    out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                         tiled=True)
+    return out.reshape(B, S_loc, -1)
+
+
 def _project(params: Pytree, x: jax.Array, cdt) -> Tuple[jax.Array, ...]:
     q = linear_apply(params["query"], x, compute_dtype=cdt)
     k = linear_apply(params["key"], x, compute_dtype=cdt)
@@ -135,7 +198,7 @@ def _project(params: Pytree, x: jax.Array, cdt) -> Tuple[jax.Array, ...]:
 
 def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
                num_heads: int = 1, seq_mesh=None, seq_axis: str = "model",
-               batch_axis: str = "data",
+               batch_axis: str = "data", seq_strategy: str = "ring",
                use_pallas: bool = False) -> jax.Array:
     """x [B,H,W,C] -> x + gamma * attention(x) (same shape/dtype).
 
@@ -153,44 +216,66 @@ def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
 
     seq_mesh=<Mesh>: sequence-parallel execution — the flattened sequence is
     sharded over `seq_axis` (the mesh layout MeshConfig.spatial produces:
-    batch over "data", image height over "model") and attention runs as a
-    `shard_map` ring over that axis, nested inside the caller's jit. The
-    surrounding convs stay under the GSPMD partitioner (halo exchanges); only
-    the attention — whose all-to-all token mixing the partitioner would
-    otherwise lower to a full k/v all-gather — is written as an explicit ring.
+    batch over "data", image height over "model") and attention runs as an
+    explicit `shard_map` nested inside the caller's jit. The surrounding
+    convs stay under the GSPMD partitioner (halo exchanges); only the
+    attention — whose all-to-all token mixing the partitioner would
+    otherwise lower to a full k/v all-gather — is written by hand, in one of
+    two strategies (`seq_strategy`):
+
+    - "ring": ppermute k/v around the axis with an online-softmax fold
+      (`ring_attention`) — any head count, n-1 neighbor hops.
+    - "ulysses": one all_to_all to head sharding, local full attention, one
+      all_to_all back (`ulysses_attention`) — needs num_heads divisible by
+      the axis size.
     """
     B, H, W, C = x.shape
     cdt = compute_dtype
     seq = x.reshape(B, H * W, C)
     q, k, v = _project(params, seq, cdt)
-    if num_heads > 1:
-        if q.shape[-1] % num_heads or v.shape[-1] % num_heads:
-            raise ValueError(
-                f"num_heads={num_heads} does not divide the projection dims "
-                f"(qk {q.shape[-1]}, v {v.shape[-1]})")
-        q, k, v = (_split_heads(t, num_heads) for t in (q, k, v))
-    scale = 1.0 / (q.shape[-1] ** 0.5)
+    if num_heads > 1 and (q.shape[-1] % num_heads
+                          or v.shape[-1] % num_heads):
+        raise ValueError(
+            f"num_heads={num_heads} does not divide the projection dims "
+            f"(qk {q.shape[-1]}, v {v.shape[-1]})")
+    scale = 1.0 / ((q.shape[-1] // num_heads) ** 0.5)
 
-    if seq_mesh is not None and seq_mesh.shape[seq_axis] > 1:
+    seq_parallel = seq_mesh is not None and seq_mesh.shape[seq_axis] > 1
+    if seq_parallel:
         n = seq_mesh.shape[seq_axis]
         if (H * W) % n:
             raise ValueError(
                 f"sequence {H}x{W} does not shard over {n} devices")
+        if seq_strategy not in ("ring", "ulysses"):
+            raise ValueError(f"unknown seq_strategy {seq_strategy!r}")
         spec = P(batch_axis, seq_axis, None)
-        ring = jax.shard_map(
-            functools.partial(ring_attention, axis_name=seq_axis,
-                              n_shards=n, scale=scale),
+
+    if seq_parallel and seq_strategy == "ulysses":
+        # heads stay unfolded: the all_to_all itself is the head split
+        f = jax.shard_map(
+            functools.partial(ulysses_attention, axis_name=seq_axis,
+                              n_shards=n, num_heads=num_heads, scale=scale,
+                              use_pallas=use_pallas),
             mesh=seq_mesh, in_specs=(spec, spec, spec), out_specs=spec)
-        out = ring(q, k, v)
-    elif use_pallas:
-        from dcgan_tpu.ops.pallas_attention import flash_attention
-
-        out = flash_attention(q, k, v, scale)
+        out = f(q, k, v)
     else:
-        out = full_attention(q, k, v, scale=scale)
+        if num_heads > 1:
+            q, k, v = (_split_heads(t, num_heads) for t in (q, k, v))
+        if seq_parallel:
+            ring = jax.shard_map(
+                functools.partial(ring_attention, axis_name=seq_axis,
+                                  n_shards=n, scale=scale),
+                mesh=seq_mesh, in_specs=(spec, spec, spec), out_specs=spec)
+            out = ring(q, k, v)
+        elif use_pallas:
+            from dcgan_tpu.ops.pallas_attention import flash_attention
 
-    if num_heads > 1:
-        out = _merge_heads(out, num_heads)
+            out = flash_attention(q, k, v, scale)
+        else:
+            out = full_attention(q, k, v, scale=scale)
+        if num_heads > 1:
+            out = _merge_heads(out, num_heads)
+
     out = linear_apply(params["out"], out.astype(v.dtype), compute_dtype=cdt)
     gamma = params["gamma"].astype(x.dtype)
     return x + gamma * out.reshape(B, H, W, C).astype(x.dtype)

@@ -60,6 +60,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attn_heads", type=int, default=1,
                    help="attention heads (1 = SAGAN paper; apply-time split, "
                         "checkpoint-compatible across head counts)")
+    p.add_argument("--seq_strategy", choices=["ring", "ulysses"],
+                   default="ring",
+                   help="sequence-parallel attention under --mesh_spatial: "
+                        "ppermute ring vs two all_to_alls (Ulysses; needs "
+                        "attn_heads divisible by the model axis)")
     p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
                    default="none",
                    help="spectral-normalize discriminator (d) or both nets' "
@@ -167,6 +172,7 @@ _FLAG_FIELDS = {
     "use_pallas": ("model", "use_pallas"),
     "attn_res": ("model", "attn_res"),
     "attn_heads": ("model", "attn_heads"),
+    "seq_strategy": ("model", "attn_seq_strategy"),
     "spectral_norm": ("model", "spectral_norm"),
     "mesh_data": ("mesh", "data"), "mesh_model": ("mesh", "model"),
     "mesh_spatial": ("mesh", "spatial"), "backend": ("", "backend"),
