@@ -61,8 +61,12 @@ def main() -> None:
         model=ModelConfig(          # 64x64, gf=df=64, bf16 compute
             use_pallas=os.environ.get("BENCH_PALLAS", "") == "1",
             # BENCH_ATTN=1: the sagan64 architecture (self-attention at
-            # 32x32); with BENCH_PALLAS=1 the block runs the flash kernels
-            attn_res=32 if os.environ.get("BENCH_ATTN", "") == "1" else 0),
+            # 32x32); with BENCH_PALLAS=1 the block runs the flash kernels.
+            # BENCH_SN=1 adds spectral norm on both nets (the full sagan64
+            # recipe's Lipschitz control)
+            attn_res=32 if os.environ.get("BENCH_ATTN", "") == "1" else 0,
+            spectral_norm="gd" if os.environ.get("BENCH_SN", "") == "1"
+            else "none"),
         batch_size=BATCH * n_chips,
         mesh=MeshConfig(),
         backend=os.environ.get("BENCH_BACKEND", "gspmd"))
