@@ -31,6 +31,12 @@ class ModelConfig:
     z_dim: int = 100               # latent dimension (image_train.py:42)
     num_classes: int = 0           # >0 activates class-conditional G/D (the
                                    # reference's dead `y` arg, distriubted_model.py:83)
+    conditional_bn: bool = False   # conditional models only: the generator's
+                                   # BN affine becomes per-class [K, C] tables
+                                   # (SAGAN/BigGAN cBN) instead of the z-concat
+                                   # conditioning alone; moments stay shared.
+                                   # cBN layers always take the jnp path (the
+                                   # fused Pallas kernels are per-channel)
     base_size: int = 4             # spatial size of the first feature map
     bn_momentum: float = 0.9       # EMA decay (distriubted_model.py:18,23)
     bn_eps: float = 1e-5           # (distriubted_model.py:18)
@@ -91,6 +97,10 @@ class ModelConfig:
             raise ValueError(
                 f"attn_seq_strategy must be 'ring' or 'ulysses', got "
                 f"{self.attn_seq_strategy!r}")
+        if self.conditional_bn and not self.num_classes:
+            raise ValueError(
+                "conditional_bn requires a conditional model "
+                "(num_classes > 0)")
 
     @property
     def num_up_layers(self) -> int:

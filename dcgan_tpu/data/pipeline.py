@@ -49,6 +49,12 @@ class DataConfig:
                                     # example (the feature the reference
                                     # comments out, image_input.py:44) and
                                     # yield (images, labels) batches
+    num_classes: int = 0            # >0: validate every label < num_classes
+                                    # host-side before transfer. On device an
+                                    # out-of-range label fails SILENTLY two
+                                    # different ways (one_hot -> zeros; the
+                                    # cBN table gather -> clamped index), so
+                                    # the pipeline is where it must be caught
     use_native: bool = True         # C++ loader; False = pure-Python fallback
     loop: bool = True
 
@@ -340,6 +346,15 @@ def make_dataset(cfg: DataConfig, sharding=None,
     it = iter(loader)
     pending = None
     for batch in it:
+        if labeled and cfg.num_classes:
+            labels = batch[1]
+            bad = int(labels.max(initial=0))
+            if bad >= cfg.num_classes or int(labels.min(initial=0)) < 0:
+                raise ValueError(
+                    f"label {bad} out of range for num_classes="
+                    f"{cfg.num_classes} (dataset/config mismatch; on device "
+                    "this would silently one-hot to zeros or clamp the cBN "
+                    "table gather)")
         nxt = to_global(batch, sharding, label_sharding)
         if pending is not None:
             yield pending

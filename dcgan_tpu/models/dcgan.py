@@ -26,7 +26,9 @@ Extensions beyond the reference (BASELINE.json configs):
   sequence-parallel ring attention when the spatial mesh shards image height;
 - spectral_norm "d"/"gd" divides every D (and G) weight by its power-iterated
   largest singular value each apply (ops/spectral.py) — the SN-GAN/SAGAN
-  Lipschitz control, with the iteration vectors as explicit sn_* state leaves.
+  Lipschitz control, with the iteration vectors as explicit sn_* state leaves;
+- conditional_bn makes the generator's BN affine per-class [K, C] tables
+  (SAGAN/BigGAN cBN) on top of the z-concat conditioning.
 
 Params/state are plain nested dicts so `jax.tree_util` / optax / checkpointing
 all work without a framework dependency.
@@ -123,7 +125,9 @@ def generator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
                             dtype=dtype),
     }
     state: Pytree = {}
-    bn_p, bn_s = batch_norm_init(keys[1], top_ch, dtype=dtype)
+    bn_classes = cfg.num_classes if cfg.conditional_bn else 0
+    bn_p, bn_s = batch_norm_init(keys[1], top_ch, dtype=dtype,
+                                 num_classes=bn_classes)
     params["bn0"], state["bn0"] = bn_p, bn_s
 
     in_ch = top_ch
@@ -132,7 +136,8 @@ def generator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
         params[f"deconv{i}"] = deconv2d_init(
             keys[2 * i], in_ch, out_ch, kernel=cfg.kernel_size, dtype=dtype)
         if i < k:
-            bn_p, bn_s = batch_norm_init(keys[2 * i + 1], out_ch, dtype=dtype)
+            bn_p, bn_s = batch_norm_init(keys[2 * i + 1], out_ch, dtype=dtype,
+                                         num_classes=bn_classes)
             params[f"bn{i}"], state[f"bn{i}"] = bn_p, bn_s
         in_ch = out_ch
     if cfg.attn_res:
@@ -191,10 +196,11 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
     h = linear_apply(layer("proj"), z.astype(cdt), compute_dtype=cdt)
     h = h.reshape(-1, cfg.base_size, cfg.base_size, top_ch)
     # BN + relu fused (one pass under use_pallas; XLA-fused otherwise)
+    bn_labels = labels if cfg.conditional_bn else None
     h, new_state["bn0"] = batch_norm_apply(
         params["bn0"], state["bn0"], h, train=train,
         momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name,
-        act="relu", use_pallas=cfg.use_pallas)
+        act="relu", use_pallas=cfg.use_pallas, labels=bn_labels)
     if cfg.attn_res == cfg.base_size:
         h = attn_apply(attn_params(), h, compute_dtype=cdt,
                        num_heads=cfg.attn_heads,
@@ -209,7 +215,8 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
             h, new_state[f"bn{i}"] = batch_norm_apply(
                 params[f"bn{i}"], state[f"bn{i}"], h, train=train,
                 momentum=cfg.bn_momentum, eps=cfg.bn_eps,
-                axis_name=axis_name, act="relu", use_pallas=cfg.use_pallas)
+                axis_name=axis_name, act="relu", use_pallas=cfg.use_pallas,
+                labels=bn_labels)
             if cfg.attn_res == cfg.base_size * (2 ** i):
                 h = attn_apply(attn_params(), h, compute_dtype=cdt,
                                num_heads=cfg.attn_heads,

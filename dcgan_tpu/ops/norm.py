@@ -33,12 +33,18 @@ Pytree = dict
 
 
 def batch_norm_init(key, num_features: int, *, dtype=jnp.float32,
-                    scale_stddev: float = 0.02) -> Tuple[Pytree, Pytree]:
+                    scale_stddev: float = 0.02,
+                    num_classes: int = 0) -> Tuple[Pytree, Pytree]:
     """Returns (params, state). gamma ~ N(1, 0.02), beta = 0 as in the reference
-    (distriubted_model.py:31-34); state starts at (mean=0, var=1)."""
+    (distriubted_model.py:31-34); state starts at (mean=0, var=1).
+
+    num_classes > 0 makes the affine CONDITIONAL (the cBN of SAGAN/BigGAN):
+    scale/bias become per-class tables [K, C] gathered per example at apply
+    time; the running moments stay shared across classes (standard cBN)."""
+    shape = (num_classes, num_features) if num_classes else (num_features,)
     params = {
-        "scale": 1.0 + scale_stddev * jax.random.normal(key, (num_features,), dtype),
-        "bias": jnp.zeros((num_features,), dtype),
+        "scale": 1.0 + scale_stddev * jax.random.normal(key, shape, dtype),
+        "bias": jnp.zeros(shape, dtype),
     }
     state = {
         "mean": jnp.zeros((num_features,), dtype),
@@ -50,7 +56,8 @@ def batch_norm_init(key, num_features: int, *, dtype=jnp.float32,
 def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
                      train: bool, momentum: float = 0.9, eps: float = 1e-5,
                      axis_name: Optional[str] = None, act: str = "none",
-                     leak: float = 0.2, use_pallas: bool = False
+                     leak: float = 0.2, use_pallas: bool = False,
+                     labels: Optional[jax.Array] = None
                      ) -> Tuple[jax.Array, Pytree]:
     """Normalize `x` over all axes but the last (channel) axis, optionally
     fusing the following activation (`act` in {"none","relu","lrelu","tanh"}).
@@ -64,6 +71,11 @@ def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
     use_pallas=True routes the moments reduction and the normalize+activation
     epilogue through the fused Pallas kernels (ops/pallas_kernels.py) — one
     HBM pass each way instead of one per op.
+
+    Conditional BN (params built with num_classes > 0): pass `labels` [B] and
+    each example is scaled/shifted by its class's row of the [K, C] tables.
+    The per-example affine breaks the fused kernels' per-channel-vector
+    contract, so cBN always takes the jnp path.
     """
     if train:
         if use_pallas:
@@ -98,15 +110,23 @@ def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
         var = state["var"]
         new_state = state
 
-    if use_pallas:
+    conditional = params["scale"].ndim == 2
+    if conditional:
+        if labels is None:
+            raise ValueError("conditional BN requires labels")
+        # per-example affine: gather class rows, broadcast over spatial dims
+        bshape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        scale = params["scale"][labels].reshape(bshape).astype(x.dtype)
+        bias = params["bias"][labels].reshape(bshape).astype(x.dtype)
+    elif use_pallas:
         from dcgan_tpu.ops.pallas_kernels import fused_bn_act
 
         y = fused_bn_act(x, params["scale"], params["bias"], mean, var,
                          eps=eps, act=act, leak=leak)
         return y, new_state
-
-    scale = params["scale"].astype(x.dtype)
-    bias = params["bias"].astype(x.dtype)
+    else:
+        scale = params["scale"].astype(x.dtype)
+        bias = params["bias"].astype(x.dtype)
     inv = lax.rsqrt(var.astype(x.dtype) + jnp.asarray(eps, x.dtype))
     y = (x - mean.astype(x.dtype)) * inv * scale + bias
     y = _apply_act(y, act, leak)

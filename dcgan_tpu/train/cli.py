@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--df_dim", type=int, default=64)
     p.add_argument("--num_classes", type=int, default=0,
                    help=">0 = class-conditional G/D")
+    p.add_argument("--conditional_bn", action="store_true",
+                   help="conditional models: per-class BN affine in G "
+                        "(SAGAN/BigGAN cBN)")
     p.add_argument("--use_pallas", action="store_true",
                    help="fused Pallas BN+activation kernels (single-chip)")
     p.add_argument("--attn_res", type=int, default=0,
@@ -170,6 +173,7 @@ _FLAG_FIELDS = {
     "z_dim": ("model", "z_dim"), "gf_dim": ("model", "gf_dim"),
     "df_dim": ("model", "df_dim"), "num_classes": ("model", "num_classes"),
     "use_pallas": ("model", "use_pallas"),
+    "conditional_bn": ("model", "conditional_bn"),
     "attn_res": ("model", "attn_res"),
     "attn_heads": ("model", "attn_heads"),
     "seq_strategy": ("model", "attn_seq_strategy"),

@@ -77,7 +77,8 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
         else cfg.num_loader_threads,
         seed=cfg.seed + seed_offset,
         normalize=cfg.normalize_inputs,
-        label_feature=cfg.label_feature if conditional else "")
+        label_feature=cfg.label_feature if conditional else "",
+        num_classes=cfg.model.num_classes if conditional else 0)
     return make_dataset(dcfg, sharding, label_sharding)
 
 

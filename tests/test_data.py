@@ -337,6 +337,30 @@ class TestPipeline:
         assert labels.shape == (16,) and labels.sharding == lsh
         assert (np.asarray(labels) < 4).all()
 
+    def test_make_dataset_label_range_guard(self, tmp_path):
+        """num_classes mismatch (e.g. a 10-class dataset fed to a 4-class
+        model) must fail host-side: on device an out-of-range label silently
+        one-hots to zeros or clamps the cBN table gather."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from dcgan_tpu.parallel import make_mesh
+        write_image_tfrecords(
+            str(tmp_path / "data"), num_examples=48, image_size=8,
+            channels=3, num_shards=3, num_classes=10)
+        cfg = DataConfig(data_dir=str(tmp_path / "data"), image_size=8,
+                         batch_size=16, min_after_dequeue=8, n_threads=2,
+                         label_feature="label", num_classes=4)
+        mesh = make_mesh()
+        sh = NamedSharding(mesh, P("data", None, None, None))
+        lsh = NamedSharding(mesh, P("data"))
+        with pytest.raises(ValueError, match="out of range for num_classes"):
+            next(make_dataset(cfg, sh, lsh))
+        # matching num_classes passes
+        ok = DataConfig(data_dir=str(tmp_path / "data"), image_size=8,
+                        batch_size=16, min_after_dequeue=8, n_threads=2,
+                        label_feature="label", num_classes=10)
+        imgs, labels = next(make_dataset(ok, sh, lsh))
+        assert (np.asarray(labels) < 10).all()
+
     def test_make_dataset_labeled_requires_label_sharding(self, tmp_path):
         write_image_tfrecords(
             str(tmp_path / "data"), num_examples=8, image_size=8,

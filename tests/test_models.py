@@ -106,6 +106,29 @@ def test_conditional_dcgan():
         generator_apply(p["gen"], s["gen"], z, cfg=cfg, train=True)
 
 
+def test_conditional_bn_generator():
+    """cBN (SAGAN/BigGAN): per-class [K, C] BN affine tables in G, gathered
+    per example; the z-concat conditioning remains on top."""
+    import dataclasses
+
+    base = ModelConfig(output_size=32, base_size=4, num_classes=10,
+                       compute_dtype="float32")
+    cfg = dataclasses.replace(base, conditional_bn=True)
+    p, s = gan_init(jax.random.key(0), cfg)
+    assert p["gen"]["bn0"]["scale"].shape[0] == 10      # per-class tables
+    assert p["disc"]["bn1"]["scale"].ndim == 1          # D stays plain BN
+    assert s["gen"]["bn0"]["mean"].ndim == 1            # shared moments
+    z = jnp.zeros((4, 100))
+    img, _ = generator_apply(p["gen"], s["gen"], z, cfg=cfg, train=True,
+                             labels=jnp.array([0, 3, 7, 9]))
+    assert img.shape == (4, 32, 32, 3)
+    # plain-BN config must keep vector tables (flag actually gates)
+    p2, _ = gan_init(jax.random.key(0), base)
+    assert p2["gen"]["bn0"]["scale"].ndim == 1
+    with pytest.raises(ValueError, match="num_classes"):
+        ModelConfig(num_classes=0, conditional_bn=True)
+
+
 def test_gan_init_partitions_params():
     p, s = gan_init(jax.random.key(0), CFG)
     assert set(p.keys()) == {"gen", "disc"}

@@ -102,6 +102,33 @@ class TestBatchNorm:
         np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=0)),
                                    np.asarray(p["bias"]), atol=1e-3)
 
+    def test_conditional_bn_per_class_affine(self):
+        """cBN: each example is scaled/shifted by its class row; moments stay
+        shared (SAGAN/BigGAN conditional BN)."""
+        p, s = batch_norm_init(jax.random.key(0), 8, num_classes=3)
+        assert p["scale"].shape == (3, 8) and p["bias"].shape == (3, 8)
+        assert s["mean"].shape == (8,)  # moments are unconditional
+        x = jax.random.normal(jax.random.key(1), (6, 4, 4, 8))
+        labels = jnp.asarray([0, 1, 2, 0, 1, 2])
+        y, s1 = batch_norm_apply(p, s, x, train=True, labels=labels)
+        assert y.shape == x.shape
+        # same input row, different class -> different output
+        x2 = jnp.broadcast_to(x[:1], x.shape)
+        y2, _ = batch_norm_apply(p, s, x2, train=True, labels=labels)
+        assert np.abs(np.asarray(y2[0] - y2[1])).max() > 1e-4
+        # class affine recovery: normalized x2 rows are identical, so
+        # y2[i] = xhat * scale[label_i] + bias[label_i]
+        xhat = (y2[0] - p["bias"][0]) / p["scale"][0]
+        recon = xhat * p["scale"][1] + p["bias"][1]
+        np.testing.assert_allclose(np.asarray(y2[1]), np.asarray(recon),
+                                   atol=1e-4)
+
+    def test_conditional_bn_requires_labels(self):
+        p, s = batch_norm_init(jax.random.key(0), 8, num_classes=3)
+        x = jax.random.normal(jax.random.key(1), (4, 2, 2, 8))
+        with pytest.raises(ValueError, match="labels"):
+            batch_norm_apply(p, s, x, train=True)
+
     def test_synced_moments_pmean(self):
         """Cross-replica BN: pmean'd moments under pmap equal global moments."""
         n = jax.local_device_count()
