@@ -64,6 +64,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sample from the EMA generator weights the checkpoint "
                         "carries (trained with --g_ema_decay > 0); default "
                         "samples the live weights")
+    p.add_argument("--interpolate", action="store_true",
+                   help="latent-space interpolation mode: each grid row "
+                        "walks z linearly between two random endpoints (the "
+                        "reference's declared-but-dead `visualize` flag, "
+                        "image_train.py:24, actually implemented)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None)
     return p
@@ -143,6 +148,11 @@ def generate(args: argparse.Namespace) -> dict:
 
     os.makedirs(args.out_dir, exist_ok=True)
     key = jax.random.key(args.seed)
+
+    if args.interpolate:
+        if not grid:
+            raise SystemExit("--interpolate needs a grid (e.g. --grid 8x8)")
+        return _interpolate(args, pt, state, mcfg, grid, data_axis, step, key)
     all_imgs: List[np.ndarray] = []
     all_labels: List[np.ndarray] = []
     made = 0
@@ -194,6 +204,52 @@ def generate(args: argparse.Namespace) -> dict:
         np.savez(args.npz, **arrays)
         paths.append(args.npz)
     return {"num_images": made, "step": step, "paths": paths}
+
+
+def _interpolate(args, pt, state, mcfg, grid, data_axis: int, step: int,
+                 key) -> dict:
+    """Latent-walk grid: row r interpolates z linearly from a random left
+    endpoint to a random right endpoint across the columns; conditional
+    models hold one class per row (--class_id fixes it grid-wide)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcgan_tpu.utils.images import save_sample_grid
+
+    rows, cols = grid
+    z_ends = jax.random.uniform(key, (2, rows, mcfg.z_dim),
+                                minval=-1.0, maxval=1.0)
+    t = jnp.linspace(0.0, 1.0, cols)[None, :, None]           # [1, C, 1]
+    z = (1.0 - t) * z_ends[0][:, None, :] + t * z_ends[1][:, None, :]
+    z = z.reshape(rows * cols, mcfg.z_dim)
+    n = z.shape[0]
+    pad = (-n) % data_axis
+    if pad:
+        # resize cycles rows, so this stays correct even when pad > n
+        # (tiny grid on a wide data mesh)
+        z = jnp.resize(z, (n + pad, mcfg.z_dim))
+
+    labels = None
+    if mcfg.num_classes:
+        per_row = (np.full((rows,), args.class_id, dtype=np.int32)
+                   if args.class_id is not None
+                   else np.arange(rows, dtype=np.int32) % mcfg.num_classes)
+        labels = np.resize(np.repeat(per_row, cols), (n + pad,))
+        imgs = jax.device_get(pt.sample(state, z, jnp.asarray(labels)))
+    else:
+        imgs = jax.device_get(pt.sample(state, z))
+
+    images = np.asarray(imgs[:n], dtype=np.float32)
+    path = os.path.join(args.out_dir, f"interp_{step:08d}.png")
+    save_sample_grid(path, images, grid)
+    paths = [path]
+    if args.npz:
+        arrays = {"images": images}
+        if labels is not None:
+            arrays["labels"] = labels[:n]
+        np.savez(args.npz, **arrays)
+        paths.append(args.npz)
+    return {"num_images": n, "step": step, "paths": paths}
 
 
 def main(argv: Optional[List[str]] = None) -> None:

@@ -70,6 +70,29 @@ class TestGenerate:
             outs[flag] = np.load(tmp_path / f"g{flag}.npz")["images"]
         assert float(np.abs(outs[True] - outs[False]).max()) > 0
 
+    def test_interpolate_mode(self, trained_ckpt, tmp_path):
+        """--interpolate: one latent-walk grid PNG (the reference's dead
+        `visualize` flag, image_train.py:24, actually implemented)."""
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", trained_ckpt,
+             "--out_dir", str(tmp_path / "out"),
+             "--grid", "3x5", "--interpolate",
+             "--output_size", "16", "--gf_dim", "8", "--df_dim", "8"])
+        result = generate(args)
+        assert result["num_images"] == 15
+        assert len(result["paths"]) == 1
+        assert os.path.exists(result["paths"][0])
+        assert "interp_" in os.path.basename(result["paths"][0])
+
+    def test_interpolate_requires_grid(self, trained_ckpt, tmp_path):
+        args = build_parser().parse_args(
+            ["--checkpoint_dir", trained_ckpt,
+             "--out_dir", str(tmp_path / "out"), "--grid", "0",
+             "--interpolate",
+             "--output_size", "16", "--gf_dim", "8", "--df_dim", "8"])
+        with pytest.raises(SystemExit, match="grid"):
+            generate(args)
+
     def test_no_checkpoint_errors(self, tmp_path):
         args = build_parser().parse_args(
             ["--checkpoint_dir", str(tmp_path / "nope"),
