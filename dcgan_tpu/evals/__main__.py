@@ -103,8 +103,10 @@ def main(argv: Optional[List[str]] = None) -> None:
     if args.synthetic:
         from dcgan_tpu.data import synthetic_batches
 
+        # pool=0: the real-side statistics need every sample distinct —
+        # cycled batches would bias the FID moments and the KID reservoir
         data = synthetic_batches(args.batch_size, args.output_size,
-                                 args.c_dim, seed=args.seed + 1)
+                                 args.c_dim, seed=args.seed + 1, pool=0)
     else:
         from dcgan_tpu.data import DataConfig, make_dataset
 

@@ -95,6 +95,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--save_summaries_secs", type=float, default=10.0)
     p.add_argument("--save_model_secs", type=float, default=600.0)
     p.add_argument("--sample_every_steps", type=int, default=100)
+    p.add_argument("--log_every_steps", type=int, default=1,
+                   help="stdout loss-line cadence (1 = the reference's "
+                        "every-step log; 0 = off)")
     p.add_argument("--activation_summary_steps", type=int, default=500,
                    help="per-layer activation histogram cadence (0 = off)")
     # profiling (SURVEY.md §5 — trace capture the reference never had)
@@ -164,6 +167,7 @@ _FLAG_FIELDS = {
     "save_summaries_secs": ("", "save_summaries_secs"),
     "save_model_secs": ("", "save_model_secs"),
     "sample_every_steps": ("", "sample_every_steps"),
+    "log_every_steps": ("", "log_every_steps"),
     "activation_summary_steps": ("", "activation_summary_steps"),
     "profile_dir": ("", "profile_dir"),
     "profile_start_step": ("", "profile_start_step"),

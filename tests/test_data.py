@@ -380,6 +380,22 @@ class TestPipeline:
         assert b.shape == (4, 8, 8, 3) and b.dtype == np.float32
         assert -1.0 <= b.min() and b.max() <= 1.0
 
+    def test_synthetic_batches_pool_cycles(self):
+        """After `pool` fresh batches the stream cycles them (host-RNG cost
+        bounded); pool=0 keeps every batch fresh."""
+        it = synthetic_batches(2, image_size=8, pool=3)
+        first = [next(it) for _ in range(3)]
+        second = [next(it) for _ in range(3)]
+        for a, b in zip(first, second):
+            np.testing.assert_array_equal(a, b)
+        # distinct batches within the pool
+        assert np.abs(first[0] - first[1]).max() > 0
+        fresh = synthetic_batches(2, image_size=8, pool=0)
+        a = [next(fresh) for _ in range(4)]
+        assert np.abs(a[0] - a[3]).max() > 0
+        with pytest.raises(ValueError, match="pool"):
+            next(synthetic_batches(2, image_size=8, pool=-1))
+
     def test_synthetic_labeled_batches(self):
         imgs, labels = next(synthetic_batches(4, image_size=8, num_classes=5))
         assert imgs.shape == (4, 8, 8, 3)
