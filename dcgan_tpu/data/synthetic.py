@@ -83,7 +83,7 @@ def synthetic_batches(batch_size: int, image_size: int = 64, channels: int = 3,
         # 0 when one batch alone exceeds the budget: fall back to fresh
         # batches rather than silently repeating a single giant one
         batch_bytes = 4 * batch_size * image_size * image_size * channels
-        pool = min(pool, (256 << 20) // batch_bytes)
+        pool = min(pool, (256 << 20) // max(1, batch_bytes))
     cache = []
     while True:
         if pool and len(cache) >= pool:
