@@ -109,6 +109,33 @@ def test_sharded_step_matches_single_device(mesh_cfg):
         <= 2 * cfg.learning_rate + 1e-5
 
 
+def test_sharded_conditional_cbn_matches_single_device():
+    """Conditional model with cBN under dp8: the per-example [K, C] table
+    gather (labels batch-sharded, tables replicated) must partition without
+    changing numerics."""
+    import dataclasses
+
+    cfg = TrainConfig(
+        model=dataclasses.replace(TINY, num_classes=4, conditional_bn=True),
+        batch_size=16)
+    xs, key = real_batch(), jax.random.key(3)
+    labels = jnp.asarray(np.arange(16) % 4)
+
+    fns = make_train_step(cfg)
+    s_ref, m_ref = jax.jit(fns.train_step)(
+        fns.init(jax.random.key(0)), xs, key, labels)
+
+    pt = make_parallel_train(cfg)
+    s_par, m_par = pt.step(pt.init(jax.random.key(0)), xs, key, labels)
+
+    np.testing.assert_allclose(float(m_par["d_loss"]), float(m_ref["d_loss"]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(m_par["g_loss"]), float(m_ref["g_loss"]),
+                               rtol=1e-5)
+    assert max_abs_diff(s_ref["params"], jax.device_get(s_par["params"])) \
+        <= 2 * cfg.learning_rate + 1e-5
+
+
 def test_multi_step_matches_sequential_steps():
     """multi_step (K steps as one lax.scan program, one dispatch) must equal
     K individual step() calls fed the same keys and batches."""
