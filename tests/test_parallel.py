@@ -78,56 +78,41 @@ def test_spatial_sharding_rules():
     assert batch_sharding(mesh, 2, spatial=True).spec == P("data", None)
 
 
-@pytest.mark.parametrize("mesh_cfg",
-                         [MeshConfig(), MeshConfig(model=2),
-                          MeshConfig(model=2, spatial=True),
-                          MeshConfig(shard_opt=True)],
-                         ids=["dp8", "dp4xtp2", "dp4xsp2", "dp8-zero1"])
-def test_sharded_step_matches_single_device(mesh_cfg):
+@pytest.mark.parametrize("mesh_cfg,model,conditional",
+                         [(MeshConfig(), TINY, False),
+                          (MeshConfig(model=2), TINY, False),
+                          (MeshConfig(model=2, spatial=True), TINY, False),
+                          (MeshConfig(shard_opt=True), TINY, False),
+                          (MeshConfig(), "cbn", True)],
+                         ids=["dp8", "dp4xtp2", "dp4xsp2", "dp8-zero1",
+                              "dp8-cbn"])
+def test_sharded_step_matches_single_device(mesh_cfg, model, conditional):
     """The sharded SPMD step must be numerically equivalent to the unsharded
     step — data parallelism here is synchronous (one global batch, global BN
     moments, all-reduced grads), NOT the reference's async Hogwild
-    (SURVEY.md §2.5)."""
-    cfg = TrainConfig(model=TINY, batch_size=16, mesh=mesh_cfg)
+    (SURVEY.md §2.5). The cbn case additionally covers the conditional-BN
+    per-example [K, C] table gather (labels batch-sharded, tables
+    replicated)."""
+    import dataclasses
+
+    if model == "cbn":
+        model = dataclasses.replace(TINY, num_classes=4, conditional_bn=True)
+    cfg = TrainConfig(model=model, batch_size=16, mesh=mesh_cfg)
     xs, key = real_batch(), jax.random.key(3)
+    labels = (jnp.asarray(np.arange(16) % model.num_classes),) \
+        if conditional else ()
 
     fns = make_train_step(cfg)
-    s_ref, m_ref = jax.jit(fns.train_step)(fns.init(jax.random.key(0)), xs, key)
+    s_ref, m_ref = jax.jit(fns.train_step)(fns.init(jax.random.key(0)), xs,
+                                           key, *labels)
 
     pt = make_parallel_train(cfg)
     s_par = pt.init(jax.random.key(0))
-    s_par, m_par = pt.step(s_par, xs, key)
+    s_par, m_par = pt.step(s_par, xs, key, *labels)
 
     # Losses agree tightly; params loosely — Adam's first step is
     # ~±lr·sign(grad), so f32 reduction-order noise between partitionings can
     # flip near-zero gradient signs, bounding the diff by ~2·lr = 4e-4.
-    np.testing.assert_allclose(float(m_par["d_loss"]), float(m_ref["d_loss"]),
-                               rtol=1e-5)
-    np.testing.assert_allclose(float(m_par["g_loss"]), float(m_ref["g_loss"]),
-                               rtol=1e-5)
-    assert max_abs_diff(s_ref["params"], jax.device_get(s_par["params"])) \
-        <= 2 * cfg.learning_rate + 1e-5
-
-
-def test_sharded_conditional_cbn_matches_single_device():
-    """Conditional model with cBN under dp8: the per-example [K, C] table
-    gather (labels batch-sharded, tables replicated) must partition without
-    changing numerics."""
-    import dataclasses
-
-    cfg = TrainConfig(
-        model=dataclasses.replace(TINY, num_classes=4, conditional_bn=True),
-        batch_size=16)
-    xs, key = real_batch(), jax.random.key(3)
-    labels = jnp.asarray(np.arange(16) % 4)
-
-    fns = make_train_step(cfg)
-    s_ref, m_ref = jax.jit(fns.train_step)(
-        fns.init(jax.random.key(0)), xs, key, labels)
-
-    pt = make_parallel_train(cfg)
-    s_par, m_par = pt.step(pt.init(jax.random.key(0)), xs, key, labels)
-
     np.testing.assert_allclose(float(m_par["d_loss"]), float(m_ref["d_loss"]),
                                rtol=1e-5)
     np.testing.assert_allclose(float(m_par["g_loss"]), float(m_ref["g_loss"]),
