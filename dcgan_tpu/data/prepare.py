@@ -23,7 +23,7 @@ import argparse
 import json
 import os
 import random
-from typing import Iterator, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 

@@ -9,7 +9,7 @@ format without needing CelebA on disk.
 from __future__ import annotations
 
 import os
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List
 
 import numpy as np
 

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import os
 import struct
-from typing import Iterable, Iterator, List, Optional
+from typing import Iterable, Iterator, List
 
 _MASK_DELTA = 0xA282EAD8
 _U32 = 0xFFFFFFFF

@@ -12,7 +12,7 @@ generation itself.
 
 from __future__ import annotations
 
-from typing import Callable, Iterable, Iterator, Optional, Tuple
+from typing import Callable, Iterable, Optional
 
 import jax
 import numpy as np

@@ -8,7 +8,7 @@ scipy.misc.imsave. Same capability, numpy + PIL, any grid shape.
 from __future__ import annotations
 
 import os
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 
