@@ -183,6 +183,11 @@ class TrainConfig:
     loss: str = "gan"              # "gan" (BCE, image_train.py:91-96) |
                                    # "wgan-gp" | "hinge" (SAGAN-style)
     gp_weight: float = 10.0        # WGAN-GP gradient-penalty coefficient
+    r1_gamma: float = 0.0          # >0 adds (gamma/2)*E[||grad_x D(x)||^2]
+                                   # on real images to the D loss (R1,
+                                   # arXiv:1801.04406) — composes with the
+                                   # "gan"/"hinge" families; 0 = off
+                                   # (reference parity)
     n_critic: int = 1              # D updates per G update. 1 = the reference's
                                    # one-D-one-G step (image_train.py:156-158);
                                    # WGAN-GP canonically uses 5 (each critic
@@ -281,6 +286,12 @@ class TrainConfig:
             raise ValueError(f"unknown update_mode {self.update_mode!r}")
         if self.n_critic < 1:
             raise ValueError(f"n_critic must be >= 1, got {self.n_critic}")
+        if self.r1_gamma < 0:
+            raise ValueError(f"r1_gamma must be >= 0, got {self.r1_gamma}")
+        if self.r1_gamma and self.loss == "wgan-gp":
+            raise ValueError(
+                "r1_gamma composes with the 'gan'/'hinge' families; "
+                "'wgan-gp' already carries its own gradient penalty")
         if not 0.0 <= self.g_ema_decay < 1.0:
             raise ValueError(
                 f"g_ema_decay must be in [0, 1), got {self.g_ema_decay}")

@@ -43,6 +43,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="D updates per G update (WGAN-GP canonical: 5)")
     p.add_argument("--gp_weight", type=float, default=10.0,
                    help="WGAN-GP gradient-penalty coefficient")
+    p.add_argument("--r1_gamma", type=float, default=0.0,
+                   help=">0 adds R1 regularization ((gamma/2)*||grad D||^2 "
+                        "on reals) to the gan/hinge families")
     # model (image_train.py:15-18 — wired here, unlike the reference)
     p.add_argument("--output_size", type=int, default=64)
     p.add_argument("--c_dim", type=int, default=3)
@@ -154,6 +157,7 @@ _FLAG_FIELDS = {
     "batch_size": ("", "batch_size"), "max_steps": ("", "max_steps"),
     "loss": ("", "loss"), "update_mode": ("", "update_mode"),
     "n_critic": ("", "n_critic"), "gp_weight": ("", "gp_weight"),
+    "r1_gamma": ("", "r1_gamma"),
     "g_ema_decay": ("", "g_ema_decay"),
     "d_learning_rate": ("", "d_learning_rate"),
     "g_learning_rate": ("", "g_learning_rate"),

@@ -72,19 +72,35 @@ def hinge_losses(real_logits: jax.Array, fake_logits: jax.Array
     return d_loss_real + d_loss_fake, d_loss_real, d_loss_fake, g_loss
 
 
+def _sq_grad_norms(critic_fn: Callable[[jax.Array], jax.Array],
+                   x: jax.Array) -> jax.Array:
+    """Per-example squared input-gradient norms ||∇_x D(x)||^2, [B].
+    The inner jax.grad sits under the outer d-loss grad in both penalty
+    users — double differentiation."""
+    grads = jax.grad(lambda x: jnp.sum(critic_fn(x)))(x)
+    return jnp.sum(jnp.square(grads.astype(jnp.float32)),
+                   axis=tuple(range(1, grads.ndim)))
+
+
+def r1_penalty(critic_fn: Callable[[jax.Array], jax.Array],
+               real: jax.Array) -> jax.Array:
+    """R1 regularization (Mescheder et al. 2018, arXiv:1801.04406):
+    E[||∇_x D(x)||^2] on REAL images only (zero-centered, no interpolates,
+    no target norm — the modern default stabilizer, composing with the BCE
+    and hinge families rather than replacing them like WGAN-GP does).
+    The caller scales by gamma/2."""
+    return jnp.mean(_sq_grad_norms(critic_fn, real))
+
+
 def gradient_penalty(critic_fn: Callable[[jax.Array], jax.Array],
                      real: jax.Array, fake: jax.Array,
                      key: jax.Array) -> jax.Array:
     """WGAN-GP penalty E[(||∇_x D(x̂)|| - 1)^2] on x̂ = ε·real + (1-ε)·fake.
 
-    `critic_fn` maps a batch of images to per-example logits [B]. The inner
-    jax.grad here sits under the outer d-loss grad — double differentiation.
+    `critic_fn` maps a batch of images to per-example logits [B].
     """
     eps = jax.random.uniform(key, (real.shape[0],) + (1,) * (real.ndim - 1),
                              dtype=real.dtype)
     interp = eps * real + (1.0 - eps) * fake
-
-    grads = jax.grad(lambda x: jnp.sum(critic_fn(x)))(interp)
-    norms = jnp.sqrt(jnp.sum(jnp.square(grads.astype(jnp.float32)),
-                             axis=tuple(range(1, grads.ndim))) + 1e-12)
+    norms = jnp.sqrt(_sq_grad_norms(critic_fn, interp) + 1e-12)
     return jnp.mean(jnp.square(norms - 1.0))

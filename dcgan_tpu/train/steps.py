@@ -149,6 +149,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
     opt_d = make_optimizer(cfg, cfg.d_learning_rate,   # per-net base rates
                            updates_per_step=cfg.n_critic)
     wgan = cfg.loss == "wgan-gp"
+    r1 = cfg.r1_gamma > 0.0
     gan_losses = {"gan": L.bce_gan_losses,
                   "wgan-gp": L.wgan_losses,
                   "hinge": L.hinge_losses}[cfg.loss]
@@ -159,7 +160,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
 
     def _loss_metrics(d_loss, d_real, d_fake, g_loss, gp) -> dict:
         # one assembly for train_step and eval_losses so the sample/* probe
-        # can never silently diverge from the training metrics
+        # can never silently diverge from the training metrics; the gp slot
+        # carries whichever penalty the config runs (WGAN-GP or R1)
         metrics = {
             "d_loss": _pmean(d_loss),
             "d_loss_real": _pmean(d_real),
@@ -168,6 +170,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         }
         if wgan:
             metrics["gp"] = _pmean(gp)
+        elif r1:
+            metrics["r1"] = _pmean(gp)
         return metrics
 
     def d_loss_fn(d_params: Pytree, g_params: Pytree, bn: Pytree,
@@ -188,19 +192,23 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             axis_name=axis_name, attn_mesh=attn_mesh)
         d_loss, d_real, d_fake = gan_losses(real_logits, fake_logits)[:3]
         gp = jnp.zeros((), jnp.float32)
-        if wgan:
+        if wgan or r1:
             # Penalty critic runs with train=False (running BN stats):
             # batch-stat BN couples D(x_i) to every x_j in the batch, which
-            # would contaminate the per-example ||grad_x D(x̂)|| the
-            # 1-Lipschitz constraint is defined on.
+            # would contaminate the per-example ||grad_x D(x)|| both
+            # penalties are defined on.
             def critic(x):
                 return discriminator_apply(
                     d_params, bn["disc"], x, cfg=mcfg, train=False,
                     labels=labels, axis_name=axis_name,
                     attn_mesh=attn_mesh)[1][:, 0]
-            gp = L.gradient_penalty(critic, images.astype(jnp.float32),
-                                    fake.astype(jnp.float32), gp_key)
-            d_loss = d_loss + cfg.gp_weight * gp
+            if wgan:
+                gp = L.gradient_penalty(critic, images.astype(jnp.float32),
+                                        fake.astype(jnp.float32), gp_key)
+                d_loss = d_loss + cfg.gp_weight * gp
+            else:  # R1: zero-centered penalty on reals only
+                gp = L.r1_penalty(critic, images.astype(jnp.float32))
+                d_loss = d_loss + 0.5 * cfg.r1_gamma * gp
         return d_loss, (d_bn2, d_real, d_fake, gp)
 
     def g_loss_fn(g_params: Pytree, d_params: Pytree, bn: Pytree,

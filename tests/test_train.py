@@ -86,6 +86,16 @@ class TestLosses:
         gp = gradient_penalty(critic, real, fake, jax.random.key(0))
         np.testing.assert_allclose(float(gp), 16.0, rtol=1e-5)
 
+    def test_r1_penalty_golden(self):
+        """For D(x) = a.x, R1 = E[||a||^2] = 25 regardless of the inputs
+        (zero-centered: no -1 target, no interpolates)."""
+        from dcgan_tpu.train.losses import r1_penalty
+
+        a = jnp.array([3.0, 4.0])
+        critic = lambda x: x @ a
+        r1 = r1_penalty(critic, jnp.ones((16, 2)))
+        np.testing.assert_allclose(float(r1), 25.0, rtol=1e-5)
+
 
 # ---------------------------------------------------------------------------
 # train step
@@ -138,6 +148,19 @@ class TestTrainStep:
         s, m = jax.jit(fns.train_step)(s, real_batch(), jax.random.key(1))
         assert "gp" in m and np.isfinite(float(m["gp"]))
         assert np.isfinite(float(m["d_loss"]))
+
+    def test_r1_step(self):
+        """R1 on the BCE family: the r1 metric appears and regularizes
+        (double differentiation through the D apply, like WGAN-GP's)."""
+        fns = make_train_step(tiny_cfg(r1_gamma=10.0))
+        s = fns.init(jax.random.key(0))
+        s, m = jax.jit(fns.train_step)(s, real_batch(), jax.random.key(1))
+        assert "r1" in m and "gp" not in m
+        assert float(m["r1"]) > 0 and np.isfinite(float(m["d_loss"]))
+
+    def test_r1_rejects_wgan_gp(self):
+        with pytest.raises(ValueError, match="r1_gamma"):
+            tiny_cfg(loss="wgan-gp", r1_gamma=10.0)
 
     def test_hinge_step(self):
         fns = make_train_step(tiny_cfg(loss="hinge"))
