@@ -188,6 +188,12 @@ class TrainConfig:
                                    # arXiv:1801.04406) — composes with the
                                    # "gan"/"hinge" families; 0 = off
                                    # (reference parity)
+    r1_interval: int = 1           # lazy regularization (StyleGAN2,
+                                   # arXiv:1912.04958 §appendix B): compute
+                                   # R1 only every k-th step with gamma
+                                   # scaled by k — same regularization
+                                   # pressure, ~1/k of the extra D cost.
+                                   # 1 = every step (the R1 paper's form)
     n_critic: int = 1              # D updates per G update. 1 = the reference's
                                    # one-D-one-G step (image_train.py:156-158);
                                    # WGAN-GP canonically uses 5 (each critic
@@ -292,6 +298,13 @@ class TrainConfig:
             raise ValueError(
                 "r1_gamma composes with the 'gan'/'hinge' families; "
                 "'wgan-gp' already carries its own gradient penalty")
+        if self.r1_interval < 1:
+            raise ValueError(
+                f"r1_interval must be >= 1, got {self.r1_interval}")
+        if self.r1_interval > 1 and not self.r1_gamma:
+            raise ValueError(
+                "r1_interval > 1 without r1_gamma is a silent no-op — set "
+                "r1_gamma > 0 to enable R1")
         if not 0.0 <= self.g_ema_decay < 1.0:
             raise ValueError(
                 f"g_ema_decay must be in [0, 1), got {self.g_ema_decay}")

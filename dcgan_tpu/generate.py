@@ -69,6 +69,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "walks z linearly between two random endpoints (the "
                         "reference's declared-but-dead `visualize` flag, "
                         "image_train.py:24, actually implemented)")
+    p.add_argument("--truncation", type=float, default=1.0,
+                   help="truncation trick: scale z by psi in (0, 1] toward "
+                        "the prior's mode — fidelity up, diversity down "
+                        "(BigGAN-style, for the U(-1,1) prior); 1 = off")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None)
     return p
@@ -107,6 +111,9 @@ def generate(args: argparse.Namespace) -> dict:
         raise SystemExit(f"--batch_size must be >= 1, got {args.batch_size}")
     if args.num_images < 1:
         raise SystemExit(f"--num_images must be >= 1, got {args.num_images}")
+    if not 0.0 < args.truncation <= 1.0:
+        raise SystemExit(
+            f"--truncation must be in (0, 1], got {args.truncation}")
     if args.class_id is not None:
         if not mcfg.num_classes:
             raise SystemExit("--class_id requires a conditional model "
@@ -158,8 +165,9 @@ def generate(args: argparse.Namespace) -> dict:
     made = 0
     batch_idx = 0
     while made < args.num_images:
-        z = jax.random.uniform(jax.random.fold_in(key, batch_idx),
-                               (batch, mcfg.z_dim), minval=-1.0, maxval=1.0)
+        z = args.truncation * jax.random.uniform(
+            jax.random.fold_in(key, batch_idx),
+            (batch, mcfg.z_dim), minval=-1.0, maxval=1.0)
         if mcfg.num_classes:
             if args.class_id is not None:
                 labels = np.full((batch,), args.class_id, dtype=np.int32)
@@ -217,8 +225,8 @@ def _interpolate(args, pt, state, mcfg, grid, data_axis: int, step: int,
     from dcgan_tpu.utils.images import save_sample_grid
 
     rows, cols = grid
-    z_ends = jax.random.uniform(key, (2, rows, mcfg.z_dim),
-                                minval=-1.0, maxval=1.0)
+    z_ends = args.truncation * jax.random.uniform(
+        key, (2, rows, mcfg.z_dim), minval=-1.0, maxval=1.0)
     t = jnp.linspace(0.0, 1.0, cols)[None, :, None]           # [1, C, 1]
     z = (1.0 - t) * z_ends[0][:, None, :] + t * z_ends[1][:, None, :]
     z = z.reshape(rows * cols, mcfg.z_dim)

@@ -57,7 +57,12 @@ class ParallelTrain:
 def make_multi_step_body(step_fn: Callable) -> Callable:
     """K train steps as one lax.scan over `step_fn`, returning the final
     state and the LAST step's metrics. Shared by both backends so the scan
-    carry/metrics semantics cannot diverge."""
+    carry/metrics semantics cannot diverge.
+
+    Exception: the lazily-computed "r1" metric (TrainConfig.r1_interval > 1)
+    reports the window MAX — the last step of a scan window is almost never
+    an R1 on-step, so last-step reporting would chart the penalty as zeros.
+    """
     def multi_body(state, images, keys, labels=None):
         def body(s, xs):
             if labels is None:
@@ -67,7 +72,8 @@ def make_multi_step_body(step_fn: Callable) -> Callable:
             return step_fn(s, img, key, lbl)
         xs = (images, keys) if labels is None else (images, keys, labels)
         state, ms = jax.lax.scan(body, state, xs)
-        return state, {k: v[-1] for k, v in ms.items()}
+        return state, {k: (v.max() if k == "r1" else v[-1])
+                       for k, v in ms.items()}
     return multi_body
 
 

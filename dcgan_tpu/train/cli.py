@@ -46,6 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--r1_gamma", type=float, default=0.0,
                    help=">0 adds R1 regularization ((gamma/2)*||grad D||^2 "
                         "on reals) to the gan/hinge families")
+    p.add_argument("--r1_interval", type=int, default=1,
+                   help="lazy regularization: compute R1 every k-th step "
+                        "with gamma scaled by k (StyleGAN2; 1 = every step)")
     # model (image_train.py:15-18 — wired here, unlike the reference)
     p.add_argument("--output_size", type=int, default=64)
     p.add_argument("--c_dim", type=int, default=3)
@@ -157,7 +160,7 @@ _FLAG_FIELDS = {
     "batch_size": ("", "batch_size"), "max_steps": ("", "max_steps"),
     "loss": ("", "loss"), "update_mode": ("", "update_mode"),
     "n_critic": ("", "n_critic"), "gp_weight": ("", "gp_weight"),
-    "r1_gamma": ("", "r1_gamma"),
+    "r1_gamma": ("", "r1_gamma"), "r1_interval": ("", "r1_interval"),
     "g_ema_decay": ("", "g_ema_decay"),
     "d_learning_rate": ("", "d_learning_rate"),
     "g_learning_rate": ("", "g_learning_rate"),

@@ -176,7 +176,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
 
     def d_loss_fn(d_params: Pytree, g_params: Pytree, bn: Pytree,
                   images: jax.Array, z: jax.Array, gp_key,
-                  labels) -> Tuple[jax.Array, Tuple]:
+                  labels, step=0, r1_every_step=False) -> Tuple[jax.Array,
+                                                                Tuple]:
         fake, _ = generator_apply(g_params, bn["gen"], z, cfg=mcfg, train=True,
                                   labels=labels, axis_name=axis_name,
                                   attn_mesh=attn_mesh)
@@ -206,9 +207,25 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                 gp = L.gradient_penalty(critic, images.astype(jnp.float32),
                                         fake.astype(jnp.float32), gp_key)
                 d_loss = d_loss + cfg.gp_weight * gp
-            else:  # R1: zero-centered penalty on reals only
+            elif cfg.r1_interval == 1 or r1_every_step:
+                # R1: zero-centered penalty on reals only, every step.
+                # r1_every_step is the eval probe's path: unscaled gamma, so
+                # the held-out d_loss is comparable across r1_interval
+                # settings (the lazy form's k-scaling is a training-schedule
+                # artifact, not a different regularizer)
                 gp = L.r1_penalty(critic, images.astype(jnp.float32))
                 d_loss = d_loss + 0.5 * cfg.r1_gamma * gp
+            else:
+                # lazy regularization (StyleGAN2): the penalty (an extra D
+                # forward + double backward) runs only on every k-th step —
+                # lax.cond executes one branch — with gamma scaled by k so
+                # the time-averaged pressure matches
+                gp = lax.cond(
+                    step % cfg.r1_interval == 0,
+                    lambda _: L.r1_penalty(critic,
+                                           images.astype(jnp.float32)),
+                    lambda _: jnp.zeros((), jnp.float32), None)
+                d_loss = d_loss + 0.5 * cfg.r1_gamma * cfg.r1_interval * gp
         return d_loss, (d_bn2, d_real, d_fake, gp)
 
     def g_loss_fn(g_params: Pytree, d_params: Pytree, bn: Pytree,
@@ -243,7 +260,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             (d_loss, (d_bn, d_real, d_fake, gp)), d_grads = jax.value_and_grad(
                 d_loss_fn, has_aux=True)(
                     params["disc"], params["gen"], bn, images, z, gp_key,
-                    labels)
+                    labels, state["step"])
             d_grads = _pmean(d_grads)
             d_updates, d_opt = opt_d.update(d_grads, state["opt"]["disc"],
                                             params["disc"])
@@ -263,7 +280,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                 (loss_i, (bn_i, real_i, fake_i, gp_i)), grads = \
                     jax.value_and_grad(d_loss_fn, has_aux=True)(
                         d_params_c, params["gen"], bn_in, images, z_i, gpk,
-                        labels)
+                        labels, state["step"])
                 grads = _pmean(grads)
                 updates, d_opt_c = opt_d.update(grads, d_opt_c, d_params_c)
                 d_params_c = optax.apply_updates(d_params_c, updates)
@@ -364,7 +381,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         params, bn = state["params"], state["bn"]
         gp_key = jax.random.key(0)
         d_loss, (_, d_real, d_fake, gp) = d_loss_fn(
-            params["disc"], params["gen"], bn, images, z, gp_key, labels)
+            params["disc"], params["gen"], bn, images, z, gp_key, labels,
+            r1_every_step=True)
         g_loss, _ = g_loss_fn(params["gen"], params["disc"], bn, z, labels)
         return _loss_metrics(d_loss, d_real, d_fake, g_loss, gp)
 

@@ -84,6 +84,20 @@ class TestGenerate:
         assert os.path.exists(result["paths"][0])
         assert "interp_" in os.path.basename(result["paths"][0])
 
+    def test_truncation_validated_and_applied(self, trained_ckpt, tmp_path):
+        base = ["--checkpoint_dir", trained_ckpt,
+                "--out_dir", str(tmp_path / "out"), "--grid", "0",
+                "--num_images", "4", "--batch_size", "4",
+                "--npz", str(tmp_path / "t.npz"),
+                "--output_size", "16", "--gf_dim", "8", "--df_dim", "8"]
+        generate(build_parser().parse_args(base + ["--truncation", "0.5"]))
+        half = np.load(tmp_path / "t.npz")["images"]
+        generate(build_parser().parse_args(base))
+        full = np.load(tmp_path / "t.npz")["images"]
+        assert np.abs(half - full).max() > 1e-5  # psi actually changes z
+        with pytest.raises(SystemExit, match="truncation"):
+            generate(build_parser().parse_args(base + ["--truncation", "0"]))
+
     def test_interpolate_requires_grid(self, trained_ckpt, tmp_path):
         args = build_parser().parse_args(
             ["--checkpoint_dir", trained_ckpt,

@@ -162,6 +162,32 @@ class TestTrainStep:
         with pytest.raises(ValueError, match="r1_gamma"):
             tiny_cfg(loss="wgan-gp", r1_gamma=10.0)
 
+    def test_r1_lazy_interval(self):
+        """r1_interval=2: the penalty runs on even steps only (lax.cond) —
+        the r1 metric is live at step 0 and exactly zero at step 1."""
+        fns = make_train_step(tiny_cfg(r1_gamma=10.0, r1_interval=2))
+        s = fns.init(jax.random.key(0))
+        step = jax.jit(fns.train_step)
+        s, m0 = step(s, real_batch(), jax.random.key(1))
+        s, m1 = step(s, real_batch(), jax.random.key(2))
+        assert float(m0["r1"]) > 0.0
+        assert float(m1["r1"]) == 0.0
+        with pytest.raises(ValueError, match="r1_interval"):
+            tiny_cfg(r1_gamma=10.0, r1_interval=0)
+        with pytest.raises(ValueError, match="no-op"):
+            tiny_cfg(r1_interval=16)  # interval without gamma
+
+    def test_r1_eval_probe_interval_independent(self):
+        """The held-out loss probe computes R1 unscaled every call, so its
+        d_loss is comparable across r1_interval settings."""
+        xs, z = real_batch(), jnp.zeros((8, 100))
+        vals = []
+        for k in (1, 4):
+            fns = make_train_step(tiny_cfg(r1_gamma=10.0, r1_interval=k))
+            s = fns.init(jax.random.key(0))
+            vals.append(float(jax.jit(fns.eval_losses)(s, xs, z)["d_loss"]))
+        np.testing.assert_allclose(vals[0], vals[1], rtol=1e-6)
+
     def test_hinge_step(self):
         fns = make_train_step(tiny_cfg(loss="hinge"))
         s0 = fns.init(jax.random.key(0))
