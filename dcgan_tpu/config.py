@@ -203,6 +203,13 @@ class TrainConfig:
                                      # semantics); "fused": both grads from the same
                                      # params, applied together (reference parity,
                                      # SURVEY.md §2.4 #2, image_train.py:156-158)
+    grad_clip: float = 0.0         # >0 clips both nets' gradients by global
+                                   # norm before Adam (optax chain); 0 = off
+                                   # (reference parity: no clipping)
+    label_smoothing: float = 0.0   # one-sided label smoothing (Salimans et
+                                   # al. 2016): D's real target becomes
+                                   # 1 - eps ("gan" loss family only);
+                                   # 0 = off (reference parity)
     g_ema_decay: float = 0.0       # >0 keeps an EMA copy of generator weights
                                    # updated per step and samples from it —
                                    # a beyond-reference FID improvement
@@ -305,6 +312,16 @@ class TrainConfig:
             raise ValueError(
                 "r1_interval > 1 without r1_gamma is a silent no-op — set "
                 "r1_gamma > 0 to enable R1")
+        if self.grad_clip < 0:
+            raise ValueError(f"grad_clip must be >= 0, got {self.grad_clip}")
+        if not 0.0 <= self.label_smoothing < 0.5:
+            raise ValueError(
+                f"label_smoothing must be in [0, 0.5), got "
+                f"{self.label_smoothing}")
+        if self.label_smoothing and self.loss != "gan":
+            raise ValueError(
+                "label_smoothing targets BCE labels and applies only to "
+                f"loss='gan', got loss={self.loss!r}")
         if not 0.0 <= self.g_ema_decay < 1.0:
             raise ValueError(
                 f"g_ema_decay must be in [0, 1), got {self.g_ema_decay}")

@@ -49,6 +49,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--r1_interval", type=int, default=1,
                    help="lazy regularization: compute R1 every k-th step "
                         "with gamma scaled by k (StyleGAN2; 1 = every step)")
+    p.add_argument("--grad_clip", type=float, default=0.0,
+                   help=">0 clips both nets' grads by global norm before "
+                        "Adam")
+    p.add_argument("--label_smoothing", type=float, default=0.0,
+                   help="one-sided label smoothing: D's real target becomes "
+                        "1-eps (gan loss only)")
     # model (image_train.py:15-18 — wired here, unlike the reference)
     p.add_argument("--output_size", type=int, default=64)
     p.add_argument("--c_dim", type=int, default=3)
@@ -161,6 +167,8 @@ _FLAG_FIELDS = {
     "loss": ("", "loss"), "update_mode": ("", "update_mode"),
     "n_critic": ("", "n_critic"), "gp_weight": ("", "gp_weight"),
     "r1_gamma": ("", "r1_gamma"), "r1_interval": ("", "r1_interval"),
+    "grad_clip": ("", "grad_clip"),
+    "label_smoothing": ("", "label_smoothing"),
     "g_ema_decay": ("", "g_ema_decay"),
     "d_learning_rate": ("", "d_learning_rate"),
     "g_learning_rate": ("", "g_learning_rate"),

@@ -34,10 +34,15 @@ def sigmoid_bce(logits: jax.Array, target: float) -> jax.Array:
     return jnp.mean(loss)
 
 
-def bce_gan_losses(real_logits: jax.Array, fake_logits: jax.Array
+def bce_gan_losses(real_logits: jax.Array, fake_logits: jax.Array, *,
+                   label_smoothing: float = 0.0
                    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Returns (d_loss, d_loss_real, d_loss_fake, g_loss)."""
-    d_loss_real = sigmoid_bce(real_logits, 1.0)
+    """Returns (d_loss, d_loss_real, d_loss_fake, g_loss).
+
+    label_smoothing > 0 softens D's REAL target to 1-eps (one-sided
+    smoothing, Salimans et al. 2016 — the fake target and the generator's
+    target stay hard, as the paper prescribes)."""
+    d_loss_real = sigmoid_bce(real_logits, 1.0 - label_smoothing)
     d_loss_fake = sigmoid_bce(fake_logits, 0.0)
     g_loss = sigmoid_bce(fake_logits, 1.0)
     return d_loss_real + d_loss_fake, d_loss_real, d_loss_fake, g_loss

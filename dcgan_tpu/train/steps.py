@@ -30,6 +30,7 @@ per-worker async parameter-server pulls/pushes (image_train.py:55-67).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Callable, Optional, Tuple
 
 import jax
@@ -85,9 +86,18 @@ def make_optimizer(cfg: TrainConfig, lr: Optional[float] = None, *,
     overrides the base rate (TTUR per-net rates); the schedule applies on
     top of whichever base is used."""
     base_lr = cfg.learning_rate if lr is None else lr
-    return optax.adam(make_lr_schedule(cfg, base_lr,
+    adam = optax.adam(make_lr_schedule(cfg, base_lr,
                                        updates_per_step=updates_per_step),
                       b1=cfg.beta1, b2=0.999, eps=1e-8)
+    # ALWAYS a 2-element chain: identity and clip_by_global_norm both carry
+    # EmptyState, so the optimizer-state tree (and therefore the checkpoint
+    # structure) is identical whatever grad_clip is — a clipped run's
+    # checkpoint restores under generate/evals configs that never heard of
+    # the flag (the same shape-invariance contract as ema_gen and the lr
+    # schedule's count, above).
+    clip = optax.clip_by_global_norm(cfg.grad_clip) if cfg.grad_clip > 0 \
+        else optax.identity()
+    return optax.chain(clip, adam)
 
 
 def init_train_state(key, cfg: TrainConfig) -> Pytree:
@@ -150,9 +160,11 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                            updates_per_step=cfg.n_critic)
     wgan = cfg.loss == "wgan-gp"
     r1 = cfg.r1_gamma > 0.0
-    gan_losses = {"gan": L.bce_gan_losses,
-                  "wgan-gp": L.wgan_losses,
-                  "hinge": L.hinge_losses}[cfg.loss]
+    gan_losses = {
+        "gan": functools.partial(L.bce_gan_losses,
+                                 label_smoothing=cfg.label_smoothing),
+        "wgan-gp": L.wgan_losses,
+        "hinge": L.hinge_losses}[cfg.loss]
     _cf = constrain_fake if constrain_fake is not None else (lambda x: x)
 
     def _pmean(x):

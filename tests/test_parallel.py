@@ -207,7 +207,8 @@ def test_zero1_opt_state_sharding():
 
     pt = make_parallel_train(cfg, mesh)
     state = pt.init(jax.random.key(0))
-    mu_w = state["opt"]["disc"][0].mu["conv1"]["w"]
+    # [0] is the grad-clip slot (EmptyState), [1] the adam chain
+    mu_w = state["opt"]["disc"][1][0].mu["conv1"]["w"]
     full = int(np.prod(mu_w.shape))
     shard_sizes = {int(np.prod(s.data.shape))
                    for s in mu_w.addressable_shards}

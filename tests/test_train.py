@@ -177,6 +177,53 @@ class TestTrainStep:
         with pytest.raises(ValueError, match="no-op"):
             tiny_cfg(r1_interval=16)  # interval without gamma
 
+    def test_label_smoothing(self):
+        """One-sided smoothing: only d_loss_real changes; hard targets at
+        eps=0 reproduce the reference trio exactly."""
+        from dcgan_tpu.train.losses import bce_gan_losses, sigmoid_bce
+
+        rl = jnp.array([2.0, -1.0])
+        fl = jnp.array([0.5, -0.5])
+        d, dr, df, g = bce_gan_losses(rl, fl, label_smoothing=0.1)
+        d0, dr0, df0, g0 = bce_gan_losses(rl, fl)
+        np.testing.assert_allclose(float(dr), float(sigmoid_bce(rl, 0.9)),
+                                   rtol=1e-6)
+        assert float(df) == float(df0) and float(g) == float(g0)
+        with pytest.raises(ValueError, match="label_smoothing"):
+            tiny_cfg(loss="hinge", label_smoothing=0.1)
+        # wired through the step
+        fns = make_train_step(tiny_cfg(label_smoothing=0.1))
+        s, m = jax.jit(fns.train_step)(fns.init(jax.random.key(0)),
+                                       real_batch(), jax.random.key(1))
+        assert np.isfinite(float(m["d_loss"]))
+
+    def test_grad_clip(self):
+        """clip_by_global_norm chains BEFORE Adam: updating with huge grads
+        under clip=1 must equal updating with the pre-clipped grads under no
+        clip (Adam itself is scale-invariant per step, so parameter movement
+        is the wrong observable)."""
+        from dcgan_tpu.train.steps import make_optimizer
+
+        params = {"w": jnp.ones((4,))}
+        grads = {"w": jnp.full((4,), 500.0)}         # global norm 1000
+        clipped = {"w": grads["w"] / 1000.0}         # norm 1
+
+        opt_c = make_optimizer(tiny_cfg(grad_clip=1.0))
+        u_c, _ = opt_c.update(grads, opt_c.init(params), params)
+
+        opt_0 = make_optimizer(tiny_cfg())
+        u_0, _ = opt_0.update(clipped, opt_0.init(params), params)
+
+        np.testing.assert_allclose(np.asarray(u_c["w"]),
+                                   np.asarray(u_0["w"]), rtol=1e-6)
+        # and the step runs end to end with the chained optimizer
+        fns = make_train_step(tiny_cfg(grad_clip=1.0))
+        _, m = jax.jit(fns.train_step)(fns.init(jax.random.key(0)),
+                                       real_batch(), jax.random.key(1))
+        assert np.isfinite(float(m["d_loss"]))
+        with pytest.raises(ValueError, match="grad_clip"):
+            tiny_cfg(grad_clip=-1.0)
+
     def test_r1_eval_probe_interval_independent(self):
         """The held-out loss probe computes R1 unscaled every call, so its
         d_loss is comparable across r1_interval settings."""

@@ -175,7 +175,8 @@ class TestTrainLoop:
         train(cfg, synthetic_data=True, max_steps=2)
         state = train(cfg, synthetic_data=True, max_steps=4)
         assert int(jax.device_get(state["step"])) == 4
-        mu_w = state["opt"]["disc"][0].mu["conv1"]["w"]
+        # [0] is the grad-clip slot (EmptyState), [1] the adam chain
+        mu_w = state["opt"]["disc"][1][0].mu["conv1"]["w"]
         full = int(np.prod(mu_w.shape))
         assert {int(np.prod(s.data.shape))
                 for s in mu_w.addressable_shards} == {full // 8}
