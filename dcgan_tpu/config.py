@@ -203,6 +203,12 @@ class TrainConfig:
                                      # semantics); "fused": both grads from the same
                                      # params, applied together (reference parity,
                                      # SURVEY.md §2.4 #2, image_train.py:156-158)
+    diffaug: str = ""              # differentiable augmentation policy for
+                                   # every D input (DiffAugment,
+                                   # arXiv:2006.10738): comma-joined subset
+                                   # of {color, translation, cutout}, e.g.
+                                   # "color,translation,cutout" for small
+                                   # datasets. "" = off (reference parity)
     grad_clip: float = 0.0         # >0 clips both nets' gradients by global
                                    # norm before Adam (optax chain); 0 = off
                                    # (reference parity: no clipping)
@@ -314,6 +320,8 @@ class TrainConfig:
                 "r1_gamma > 0 to enable R1")
         if self.grad_clip < 0:
             raise ValueError(f"grad_clip must be >= 0, got {self.grad_clip}")
+        from dcgan_tpu.ops.augment import parse_policy
+        parse_policy(self.diffaug)  # raises on unknown policy names
         if not 0.0 <= self.label_smoothing < 0.5:
             raise ValueError(
                 f"label_smoothing must be in [0, 0.5), got "

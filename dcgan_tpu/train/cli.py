@@ -49,6 +49,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--r1_interval", type=int, default=1,
                    help="lazy regularization: compute R1 every k-th step "
                         "with gamma scaled by k (StyleGAN2; 1 = every step)")
+    p.add_argument("--diffaug", default="",
+                   help="DiffAugment policy for every D input, e.g. "
+                        "'color,translation,cutout' (small datasets); "
+                        "'' = off")
     p.add_argument("--grad_clip", type=float, default=0.0,
                    help=">0 clips both nets' grads by global norm before "
                         "Adam")
@@ -167,7 +171,7 @@ _FLAG_FIELDS = {
     "loss": ("", "loss"), "update_mode": ("", "update_mode"),
     "n_critic": ("", "n_critic"), "gp_weight": ("", "gp_weight"),
     "r1_gamma": ("", "r1_gamma"), "r1_interval": ("", "r1_interval"),
-    "grad_clip": ("", "grad_clip"),
+    "grad_clip": ("", "grad_clip"), "diffaug": ("", "diffaug"),
     "label_smoothing": ("", "label_smoothing"),
     "g_ema_decay": ("", "g_ema_decay"),
     "d_learning_rate": ("", "d_learning_rate"),

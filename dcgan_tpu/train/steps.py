@@ -160,6 +160,18 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                            updates_per_step=cfg.n_critic)
     wgan = cfg.loss == "wgan-gp"
     r1 = cfg.r1_gamma > 0.0
+    from dcgan_tpu.ops.augment import diff_augment, parse_policy
+    aug_policy = parse_policy(cfg.diffaug)
+
+    def _aug(x, key, idx):
+        # DiffAugment on every D input; off (or the eval probe's aug-free
+        # path, key=None) = identity. `idx` decorrelates the per-input
+        # transform streams within one step — callers never fold keys
+        # themselves, so a new call site cannot reuse a stream by accident.
+        if not aug_policy or key is None:
+            return x
+        return diff_augment(x, jax.random.fold_in(key, idx), aug_policy)
+
     gan_losses = {
         "gan": functools.partial(L.bce_gan_losses,
                                  label_smoothing=cfg.label_smoothing),
@@ -188,20 +200,23 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
 
     def d_loss_fn(d_params: Pytree, g_params: Pytree, bn: Pytree,
                   images: jax.Array, z: jax.Array, gp_key,
-                  labels, step=0, r1_every_step=False) -> Tuple[jax.Array,
-                                                                Tuple]:
+                  labels, step=0, r1_every_step=False,
+                  aug_key=None) -> Tuple[jax.Array, Tuple]:
         fake, _ = generator_apply(g_params, bn["gen"], z, cfg=mcfg, train=True,
                                   labels=labels, axis_name=axis_name,
                                   attn_mesh=attn_mesh)
         fake = _cf(fake)
         # D sees real then fake, chaining BN state through both applications —
         # the functional analogue of the reference's two discriminator() calls
-        # with reuse=True (image_train.py:82,85).
+        # with reuse=True (image_train.py:82,85). Each D input is
+        # independently DiffAugmented when the policy is on.
         _, real_logits, d_bn1 = discriminator_apply(
-            d_params, bn["disc"], images, cfg=mcfg, train=True, labels=labels,
+            d_params, bn["disc"], _aug(images, aug_key, 0),
+            cfg=mcfg, train=True, labels=labels,
             axis_name=axis_name, attn_mesh=attn_mesh)
         _, fake_logits, d_bn2 = discriminator_apply(
-            d_params, d_bn1, fake, cfg=mcfg, train=True, labels=labels,
+            d_params, d_bn1, _aug(fake, aug_key, 1),
+            cfg=mcfg, train=True, labels=labels,
             axis_name=axis_name, attn_mesh=attn_mesh)
         d_loss, d_real, d_fake = gan_losses(real_logits, fake_logits)[:3]
         gp = jnp.zeros((), jnp.float32)
@@ -209,7 +224,9 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             # Penalty critic runs with train=False (running BN stats):
             # batch-stat BN couples D(x_i) to every x_j in the batch, which
             # would contaminate the per-example ||grad_x D(x)|| both
-            # penalties are defined on.
+            # penalties are defined on. Penalties act on the RAW inputs —
+            # the Lipschitz constraint lives in image space, not in
+            # DiffAugment's transformed space.
             def critic(x):
                 return discriminator_apply(
                     d_params, bn["disc"], x, cfg=mcfg, train=False,
@@ -241,14 +258,18 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         return d_loss, (d_bn2, d_real, d_fake, gp)
 
     def g_loss_fn(g_params: Pytree, d_params: Pytree, bn: Pytree,
-                  z: jax.Array, labels) -> Tuple[jax.Array, Tuple]:
+                  z: jax.Array, labels, aug_key=None) -> Tuple[jax.Array,
+                                                               Tuple]:
         fake, g_bn = generator_apply(g_params, bn["gen"], z, cfg=mcfg,
                                      train=True, labels=labels,
                                      axis_name=axis_name, attn_mesh=attn_mesh)
         fake = _cf(fake)
+        # generator gradients flow THROUGH the augmentation — the property
+        # DiffAugment needs (arXiv:2006.10738)
         _, fake_logits, _ = discriminator_apply(
-            d_params, bn["disc"], fake, cfg=mcfg, train=True, labels=labels,
-            axis_name=axis_name, attn_mesh=attn_mesh)
+            d_params, bn["disc"], _aug(fake, aug_key, 2), cfg=mcfg,
+            train=True, labels=labels, axis_name=axis_name,
+            attn_mesh=attn_mesh)
         # the family's own generator loss (4th return) — single-sourced with
         # the D-side dispatch; every family's g_loss depends only on the
         # fake logits, so the real-logits slot gets a dummy (its unused
@@ -260,7 +281,13 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
     def train_step(state: Pytree, images: jax.Array, key: jax.Array,
                    labels: Optional[jax.Array] = None
                    ) -> Tuple[Pytree, dict]:
-        z_key, gp_key = jax.random.split(key)
+        # the 3-way split happens only when DiffAugment is on, so every
+        # stream (z, gp) is bit-identical to reference-parity runs otherwise
+        if aug_policy:
+            z_key, gp_key, aug_key = jax.random.split(key, 3)
+        else:
+            z_key, gp_key = jax.random.split(key)
+            aug_key = None
         z = jax.random.uniform(
             z_key, (images.shape[0], mcfg.z_dim),
             minval=-1.0, maxval=1.0, dtype=jnp.float32)
@@ -272,7 +299,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             (d_loss, (d_bn, d_real, d_fake, gp)), d_grads = jax.value_and_grad(
                 d_loss_fn, has_aux=True)(
                     params["disc"], params["gen"], bn, images, z, gp_key,
-                    labels, state["step"])
+                    labels, state["step"], False, aug_key)
             d_grads = _pmean(d_grads)
             d_updates, d_opt = opt_d.update(d_grads, state["opt"]["disc"],
                                             params["disc"])
@@ -285,6 +312,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             def critic_iter(carry, iter_key):
                 d_params_c, d_opt_c, d_bn_c, _ = carry
                 zk, gpk = jax.random.split(iter_key)
+                aug_k = jax.random.fold_in(iter_key, 3) if aug_policy \
+                    else None
                 z_i = jax.random.uniform(
                     zk, (images.shape[0], mcfg.z_dim),
                     minval=-1.0, maxval=1.0, dtype=jnp.float32)
@@ -292,7 +321,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                 (loss_i, (bn_i, real_i, fake_i, gp_i)), grads = \
                     jax.value_and_grad(d_loss_fn, has_aux=True)(
                         d_params_c, params["gen"], bn_in, images, z_i, gpk,
-                        labels, state["step"])
+                        labels, state["step"], False, aug_k)
                 grads = _pmean(grads)
                 updates, d_opt_c = opt_d.update(grads, d_opt_c, d_params_c)
                 d_params_c = optax.apply_updates(d_params_c, updates)
@@ -321,7 +350,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         # --- G step ---------------------------------------------------------
         (g_loss, (g_bn,)), g_grads = jax.value_and_grad(
             g_loss_fn, has_aux=True)(
-                params["gen"], g_target_disc, g_bn_in, z, labels)
+                params["gen"], g_target_disc, g_bn_in, z, labels, aug_key)
         g_grads = _pmean(g_grads)
         g_updates, g_opt = opt_g.update(g_grads, state["opt"]["gen"],
                                         params["gen"])
