@@ -55,8 +55,11 @@ def main() -> None:
 
     from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
     from dcgan_tpu.parallel import make_mesh, make_parallel_train
+    from dcgan_tpu.utils.backend import acquire_devices
 
-    n_chips = len(jax.devices())
+    # Bounded retry/backoff: one transient UNAVAILABLE from the tunneled
+    # TPU plugin must not zero out the round's bench (BENCH_r01.json rc=1).
+    n_chips = len(acquire_devices())
     cfg = TrainConfig(
         model=ModelConfig(          # 64x64, gf=df=64, bf16 compute
             use_pallas=os.environ.get("BENCH_PALLAS", "") == "1",
@@ -139,5 +142,66 @@ def main() -> None:
           f"d_loss={final_d_loss:.3f}", file=sys.stderr)
 
 
+def _run_with_retry() -> None:
+    """Parent wrapper: run the measurement in a child process, bounded retry.
+
+    acquire_devices() already retries backend *init* in-process, but the
+    tunneled transport can also fail mid-run (compile-time UNAVAILABLE,
+    dropped tunnel during a measurement window).  A fresh child process per
+    attempt is immune to any poisoned interpreter state.  Child stdout (the
+    one JSON line) and stderr pass straight through to the driver.
+    """
+    import subprocess
+
+    attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", 3)))
+    # Against a dead tunnel jax.devices() has been observed to HANG (not
+    # raise) — a per-attempt wall clock turns that into a retryable failure.
+    child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT", 900))
+    def _text(s):
+        return s.decode(errors="replace") if isinstance(s, bytes) else (s or "")
+
+    delay = 5.0
+    rc = 1
+    for i in range(attempts):
+        env = dict(os.environ, BENCH_CHILD="1")
+        # Child stdout is CAPTURED and forwarded only on success: a child
+        # that printed its JSON line and then died/hung must not leave a
+        # stale line ahead of a later attempt's (one-JSON-line contract).
+        try:
+            res = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                 env=env, timeout=child_timeout,
+                                 capture_output=True, text=True)
+            rc = res.returncode
+            sys.stderr.write(_text(res.stderr))
+            if rc == 0:
+                sys.stdout.write(_text(res.stdout))
+                sys.exit(0)
+            sys.stderr.write(_text(res.stdout))  # failed child's stdout
+        except subprocess.TimeoutExpired as te:
+            rc = -1
+            sys.stderr.write(_text(te.stderr))
+            sys.stderr.write(_text(te.output))
+            print(f"bench attempt {i + 1}/{attempts} timed out after "
+                  f"{child_timeout:.0f}s", file=sys.stderr)
+        print(f"bench attempt {i + 1}/{attempts} failed (rc={rc})",
+              file=sys.stderr)
+        if i + 1 < attempts:
+            time.sleep(delay)
+            delay = min(delay * 2, 60.0)
+    # Structured one-line JSON error so the round artifact is parseable
+    # even on total failure (VERDICT round 1, item 1).
+    print(json.dumps({
+        "metric": "bench_error",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": f"bench failed after {attempts} attempts (last rc={rc})",
+    }))
+    sys.exit(1)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD") == "1":
+        main()
+    else:
+        _run_with_retry()
