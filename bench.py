@@ -60,25 +60,44 @@ def main() -> None:
     # Bounded retry/backoff: one transient UNAVAILABLE from the tunneled
     # TPU plugin must not zero out the round's bench (BENCH_r01.json rc=1).
     n_chips = len(acquire_devices())
-    cfg = TrainConfig(
-        model=ModelConfig(          # 64x64, gf=df=64, bf16 compute
-            use_pallas=os.environ.get("BENCH_PALLAS", "") == "1",
-            # BENCH_ATTN=1: the sagan64 architecture (self-attention at
-            # 32x32); with BENCH_PALLAS=1 the block runs the flash kernels.
-            # BENCH_SN=1 adds spectral norm on both nets (the full sagan64
-            # recipe's Lipschitz control)
-            attn_res=32 if os.environ.get("BENCH_ATTN", "") == "1" else 0,
-            spectral_norm="gd" if os.environ.get("BENCH_SN", "") == "1"
-            else "none"),
-        batch_size=BATCH * n_chips,
-        mesh=MeshConfig(),
-        backend=os.environ.get("BENCH_BACKEND", "gspmd"))
+    preset_name = os.environ.get("BENCH_PRESET", "")
+    if preset_name:
+        # Bench any named config (VERDICT r1 #4): the preset supplies
+        # architecture + loss + optimizer recipe; batch/mesh are re-derived
+        # for the chips actually present (BENCH_BATCH stays per-chip).
+        import dataclasses
+
+        from dcgan_tpu.presets import get_preset
+
+        cfg = dataclasses.replace(
+            get_preset(preset_name),
+            batch_size=BATCH * n_chips,
+            mesh=MeshConfig(),
+            backend=os.environ.get("BENCH_BACKEND", "gspmd"))
+    else:
+        cfg = TrainConfig(
+            model=ModelConfig(          # 64x64, gf=df=64, bf16 compute
+                use_pallas=os.environ.get("BENCH_PALLAS", "") == "1",
+                # BENCH_ATTN=1: the sagan64 architecture (self-attention at
+                # 32x32); with BENCH_PALLAS=1 the block runs the flash
+                # kernels. BENCH_SN=1 adds spectral norm on both nets (the
+                # full sagan64 recipe's Lipschitz control)
+                attn_res=32 if os.environ.get("BENCH_ATTN", "") == "1" else 0,
+                spectral_norm="gd" if os.environ.get("BENCH_SN", "") == "1"
+                else "none"),
+            batch_size=BATCH * n_chips,
+            mesh=MeshConfig(),
+            backend=os.environ.get("BENCH_BACKEND", "gspmd"))
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
 
+    size = cfg.model.output_size
     state = pt.init(jax.random.key(0))
     images = jnp.asarray(np.random.default_rng(0).uniform(
-        -1, 1, size=(cfg.batch_size, 64, 64, 3)).astype(np.float32))
+        -1, 1, size=(cfg.batch_size, size, size, cfg.model.c_dim))
+        .astype(np.float32))
+    labels = (jnp.asarray(np.arange(cfg.batch_size) % cfg.model.num_classes),
+              ) if cfg.model.num_classes else ()
     base = jax.random.key(1)
 
     # Warmup compiles exactly the program the measurement uses. Sync by
@@ -89,13 +108,15 @@ def main() -> None:
     # per-step fetch costs a full tunnel round-trip (~100 ms measured).
     if SCAN > 1:
         imgs_k = jnp.broadcast_to(images, (SCAN,) + images.shape)
+        labels_k = tuple(jnp.broadcast_to(l, (SCAN,) + l.shape)
+                         for l in labels)
         state, metrics = pt.multi_step(
             state, imgs_k, jax.random.split(jax.random.fold_in(base, 999),
-                                            SCAN))
+                                            SCAN), *labels_k)
     else:
         for i in range(STEPS_WARMUP):
             state, metrics = pt.step(state, images,
-                                     jax.random.fold_in(base, i))
+                                     jax.random.fold_in(base, i), *labels)
     float(metrics["d_loss"])
 
     # Best of WINDOWS measurement windows: the tunneled transport's
@@ -116,19 +137,23 @@ def main() -> None:
             for _ in range(n_calls):
                 keys = jax.random.split(jax.random.fold_in(base, step_idx),
                                         SCAN)
-                state, metrics = pt.multi_step(state, imgs_k, keys)
+                state, metrics = pt.multi_step(state, imgs_k, keys, *labels_k)
                 step_idx += 1
         else:
             for _ in range(STEPS_MEASURE):
                 state, metrics = pt.step(state, images,
-                                         jax.random.fold_in(base, step_idx))
+                                         jax.random.fold_in(base, step_idx),
+                                         *labels)
                 step_idx += 1
         final_d_loss = float(metrics["d_loss"])  # hard sync ends the window
         dt = min(dt, time.perf_counter() - t0)
 
     img_per_sec = cfg.batch_size * steps_window / dt
     img_per_sec_chip = img_per_sec / n_chips
-    arch = "SAGAN-64" if cfg.model.attn_res else "DCGAN-64"
+    if preset_name:
+        arch = preset_name
+    else:
+        arch = "SAGAN-64" if cfg.model.attn_res else "DCGAN-64"
     print(json.dumps({
         "metric": f"{arch} train throughput (batch {BATCH}/chip, bf16)",
         "value": round(img_per_sec_chip, 1),

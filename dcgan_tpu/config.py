@@ -11,8 +11,11 @@ actually used — SURVEY.md §2.4 #8, distriubted_model.py:7-12 vs image_train.p
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
-from typing import Optional, Tuple
+import os
+import sys
+from typing import Any, Dict, Optional, Tuple
 
 
 @dataclasses.dataclass(frozen=True)
@@ -372,3 +375,95 @@ class TrainConfig:
             raise ValueError(
                 "update_mode='fused' (reference-parity single fused step) is "
                 "defined only for n_critic=1")
+
+
+# --------------------------------------------------------------------------
+# Checkpoint-side config persistence (VERDICT r1 #3).
+#
+# The reference's Saver stored only variables; restoring required the user to
+# re-specify every architecture flag, and a mismatch surfaced as an opaque
+# restore error (image_train.py:233-245 had the same hazard). Here the
+# trainer writes the full TrainConfig as `config.json` next to the Orbax step
+# dirs, and generate/evals/resume read it back — so
+# `python -m dcgan_tpu.generate --checkpoint_dir ckpt` needs zero
+# architecture flags, and a resume with mismatched architecture fails with a
+# clear message instead of an Orbax shape error.
+# --------------------------------------------------------------------------
+
+CONFIG_FILENAME = "config.json"
+
+
+def config_to_dict(cfg: TrainConfig) -> Dict[str, Any]:
+    return dataclasses.asdict(cfg)
+
+
+def _known_fields(cls, d: Dict[str, Any], *, context: str) -> Dict[str, Any]:
+    """Filter a dict to cls's fields; warn (don't fail) on unknown keys so a
+    checkpoint written by a NEWER framework version still loads."""
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = sorted(set(d) - names)
+    if unknown:
+        print(f"[dcgan_tpu] ignoring unknown {context} config keys "
+              f"{unknown} (checkpoint written by a newer version?)",
+              file=sys.stderr)
+    return {k: v for k, v in d.items() if k in names}
+
+
+def config_from_dict(d: Dict[str, Any]) -> TrainConfig:
+    d = dict(d)
+    model = ModelConfig(**_known_fields(ModelConfig, dict(d.pop("model", {})),
+                                        context="model"))
+    mesh = MeshConfig(**_known_fields(MeshConfig, dict(d.pop("mesh", {})),
+                                      context="mesh"))
+    rest = _known_fields(TrainConfig, d, context="train")
+    if "sample_grid" in rest:  # JSON round-trips tuples as lists
+        rest["sample_grid"] = tuple(rest["sample_grid"])
+    return TrainConfig(model=model, mesh=mesh, **rest)
+
+
+def save_config(cfg: TrainConfig, directory: str) -> str:
+    """Write config.json atomically (tmp + rename); returns the path."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, CONFIG_FILENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(config_to_dict(cfg), f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_config(directory: str) -> Optional[TrainConfig]:
+    """The TrainConfig stored next to a checkpoint, or None if absent."""
+    path = os.path.join(directory, CONFIG_FILENAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return config_from_dict(json.load(f))
+
+
+# The ModelConfig knobs checkpoint consumers (generate/evals CLIs) expose as
+# override flags — one list so the two parsers cannot drift apart.
+MODEL_OVERRIDE_FLAGS = ("output_size", "c_dim", "z_dim", "gf_dim", "df_dim",
+                        "num_classes", "conditional_bn", "attn_res",
+                        "attn_heads", "spectral_norm")
+
+
+def resolve_model_config(checkpoint_dir: str, *, preset: Optional[str] = None,
+                         overrides: Optional[Dict[str, Any]] = None
+                         ) -> ModelConfig:
+    """Architecture resolution for checkpoint consumers (generate/evals).
+
+    Precedence: explicit flag overrides > --preset > the checkpoint's own
+    config.json > ModelConfig defaults. `overrides` values of None mean
+    "not passed" and are dropped.
+    """
+    if preset:
+        from dcgan_tpu.presets import get_preset  # lazy: presets imports us
+
+        base = get_preset(preset).model
+    else:
+        saved = load_config(checkpoint_dir)
+        base = saved.model if saved is not None else ModelConfig()
+    given = {k: v for k, v in (overrides or {}).items() if v is not None}
+    return dataclasses.replace(base, **given)

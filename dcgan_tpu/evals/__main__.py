@@ -28,21 +28,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="score against the synthetic data stream")
     p.add_argument("--num_samples", type=int, default=50_000)
     p.add_argument("--batch_size", type=int, default=256)
-    p.add_argument("--output_size", type=int, default=64)
-    p.add_argument("--c_dim", type=int, default=3)
-    p.add_argument("--z_dim", type=int, default=100)
-    p.add_argument("--gf_dim", type=int, default=64)
-    p.add_argument("--df_dim", type=int, default=64)
-    p.add_argument("--num_classes", type=int, default=0)
-    p.add_argument("--attn_res", type=int, default=0,
+    # architecture flags default to None = "take it from the checkpoint's
+    # config.json" (written by the trainer); explicit flags override
+    p.add_argument("--output_size", type=int, default=None)
+    p.add_argument("--c_dim", type=int, default=None)
+    p.add_argument("--z_dim", type=int, default=None)
+    p.add_argument("--gf_dim", type=int, default=None)
+    p.add_argument("--df_dim", type=int, default=None)
+    p.add_argument("--num_classes", type=int, default=None)
+    p.add_argument("--attn_res", type=int, default=None,
                    help="match the checkpoint's attention config")
-    p.add_argument("--attn_heads", type=int, default=1,
+    p.add_argument("--attn_heads", type=int, default=None,
                    help="match the checkpoint's attention head count (an "
                         "apply-time split — a mismatch loads cleanly but "
                         "evaluates a different network)")
     p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
-                   default="none",
+                   default=None,
                    help="match the checkpoint's spectral-norm config")
+    p.add_argument("--conditional_bn", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="match the checkpoint's conditional-BN config "
+                        "([K, C] per-class BN tables in G)")
     p.add_argument("--kid", action="store_true",
                    help="also report KID (subset-averaged unbiased MMD^2) "
                         "from the same feature pass")
@@ -72,20 +78,20 @@ def main(argv: Optional[List[str]] = None) -> None:
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
-    from dcgan_tpu.config import ModelConfig, TrainConfig
+    from dcgan_tpu.config import MODEL_OVERRIDE_FLAGS, TrainConfig, \
+        resolve_model_config
     from dcgan_tpu.evals.features import make_npz_feature_fn
     from dcgan_tpu.evals.job import compute_fid
     from dcgan_tpu.parallel import batch_sharding, make_mesh, \
         make_parallel_train
     from dcgan_tpu.utils.checkpoint import Checkpointer
 
+    mcfg = resolve_model_config(
+        args.checkpoint_dir,
+        overrides={name: getattr(args, name)
+                   for name in MODEL_OVERRIDE_FLAGS})
     cfg = TrainConfig(
-        model=ModelConfig(output_size=args.output_size, c_dim=args.c_dim,
-                          z_dim=args.z_dim, gf_dim=args.gf_dim,
-                          df_dim=args.df_dim, num_classes=args.num_classes,
-                          attn_res=args.attn_res,
-                          attn_heads=args.attn_heads,
-                          spectral_norm=args.spectral_norm),
+        model=mcfg,
         batch_size=args.batch_size,
         checkpoint_dir=args.checkpoint_dir,
         # any value > 0 makes sample() read state["ema_gen"]
@@ -105,13 +111,13 @@ def main(argv: Optional[List[str]] = None) -> None:
 
         # pool=0: the real-side statistics need every sample distinct —
         # cycled batches would bias the FID moments and the KID reservoir
-        data = synthetic_batches(args.batch_size, args.output_size,
-                                 args.c_dim, seed=args.seed + 1, pool=0)
+        data = synthetic_batches(args.batch_size, mcfg.output_size,
+                                 mcfg.c_dim, seed=args.seed + 1, pool=0)
     else:
         from dcgan_tpu.data import DataConfig, make_dataset
 
         dcfg = DataConfig(data_dir=args.data_dir,
-                          image_size=args.output_size, channels=args.c_dim,
+                          image_size=mcfg.output_size, channels=mcfg.c_dim,
                           batch_size=args.batch_size, seed=args.seed,
                           normalize=True)
         data = make_dataset(dcfg, batch_sharding(mesh, 4))
@@ -125,9 +131,9 @@ def main(argv: Optional[List[str]] = None) -> None:
             else pt.sample(state, z)
 
     result = compute_fid(
-        sample_fn, data, image_size=args.output_size, c_dim=args.c_dim,
-        z_dim=args.z_dim, num_samples=args.num_samples,
-        batch_size=args.batch_size, num_classes=args.num_classes,
+        sample_fn, data, image_size=mcfg.output_size, c_dim=mcfg.c_dim,
+        z_dim=mcfg.z_dim, num_samples=args.num_samples,
+        batch_size=args.batch_size, num_classes=mcfg.num_classes,
         seed=args.seed, feature_fn=feature_fn, feature_dim=feature_dim,
         kid=args.kid, kid_subset_size=args.kid_subset_size,
         kid_subsets=args.kid_subsets, kid_pool_size=args.kid_pool)

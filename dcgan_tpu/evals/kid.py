@@ -57,10 +57,19 @@ class FeaturePool:
 
     def merge(self, other: "FeaturePool") -> "FeaturePool":
         """Fold another pool in, keeping the union uniform: each slot draws
-        from self/other proportional to their stream counts."""
+        from self/other proportional to their stream counts.
+
+        Each side's buffer is shuffled before the draw: a reservoir's
+        *contents* are a uniform sample but its *order* correlates with
+        stream position (the fill phase is stream-ordered), so consuming
+        sequential prefixes would bias the merged sample toward
+        early-stream features whenever take < mine+theirs (ADVICE r1)."""
         if other.dim != self.dim or other.capacity != self.capacity:
             raise ValueError("pool shape mismatch")
-        mine, theirs = self.features(), other.features()
+        mine = self.features().copy()
+        theirs = other.features().copy()
+        self._rng.shuffle(mine)
+        self._rng.shuffle(theirs)
         total = self.n_seen + other.n_seen
         take = min(self.capacity, len(mine) + len(theirs))
         p_other = other.n_seen / max(1, total)

@@ -36,13 +36,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="RxC tiling per PNG (e.g. 8x8); 0 disables PNGs")
     p.add_argument("--npz", default=None,
                    help="also dump all images (and labels) to this .npz")
-    # model architecture — must match the checkpoint. Defaults are None so
-    # "explicitly passed" is distinguishable from "omitted" when a --preset
-    # supplies the base architecture; omitted flags fall back to the preset's
-    # values, else to ModelConfig defaults (64x64, gf=df=64, z=100).
+    # model architecture — resolved from the checkpoint's own config.json
+    # (written by the trainer) by default, so no flags are needed to sample
+    # any checkpoint. Defaults are None so "explicitly passed" is
+    # distinguishable from "omitted"; precedence is explicit flag > --preset
+    # > checkpoint config.json > ModelConfig defaults.
     p.add_argument("--preset", default=None,
                    help="named config (presets.py) supplying the model "
-                        "architecture; explicit flags override")
+                        "architecture instead of the checkpoint's "
+                        "config.json; explicit flags override")
     p.add_argument("--output_size", type=int, default=None)
     p.add_argument("--c_dim", type=int, default=None)
     p.add_argument("--z_dim", type=int, default=None)
@@ -57,6 +59,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
                    default=None,
                    help="match the checkpoint's spectral-norm config")
+    p.add_argument("--conditional_bn", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="match the checkpoint's conditional-BN config "
+                        "([K, C] per-class BN tables in G)")
     p.add_argument("--class_id", type=int, default=None,
                    help="conditional models: generate only this class "
                         "(default: cycle all classes)")
@@ -78,23 +84,13 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-_MODEL_FLAGS = ("output_size", "c_dim", "z_dim", "gf_dim", "df_dim",
-                "num_classes", "attn_res", "attn_heads", "spectral_norm")
-
-
 def _model_config(args: argparse.Namespace):
-    import dataclasses
+    from dcgan_tpu.config import MODEL_OVERRIDE_FLAGS, resolve_model_config
 
-    from dcgan_tpu.config import ModelConfig
-
-    if args.preset:
-        from dcgan_tpu.presets import get_preset
-        base = get_preset(args.preset).model
-    else:
-        base = ModelConfig()
-    given = {name: getattr(args, name) for name in _MODEL_FLAGS
-             if getattr(args, name) is not None}
-    return dataclasses.replace(base, **given)
+    return resolve_model_config(
+        args.checkpoint_dir, preset=args.preset,
+        overrides={name: getattr(args, name)
+                   for name in MODEL_OVERRIDE_FLAGS})
 
 
 def generate(args: argparse.Namespace) -> dict:

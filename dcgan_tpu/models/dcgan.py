@@ -159,6 +159,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
                     labels: Optional[jax.Array] = None,
                     axis_name: Optional[str] = None,
                     attn_mesh=None,
+                    pallas_mesh=None,
                     capture: Optional[dict] = None
                     ) -> Tuple[jax.Array, Pytree]:
     """z [B, z_dim] (-1..1) -> image [B, S, S, c_dim] in tanh range.
@@ -200,7 +201,8 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
     h, new_state["bn0"] = batch_norm_apply(
         params["bn0"], state["bn0"], h, train=train,
         momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name,
-        act="relu", use_pallas=cfg.use_pallas, labels=bn_labels)
+        act="relu", use_pallas=cfg.use_pallas, labels=bn_labels,
+        pallas_mesh=pallas_mesh)
     if cfg.attn_res == cfg.base_size:
         h = attn_apply(attn_params(), h, compute_dtype=cdt,
                        num_heads=cfg.attn_heads,
@@ -216,7 +218,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
                 params[f"bn{i}"], state[f"bn{i}"], h, train=train,
                 momentum=cfg.bn_momentum, eps=cfg.bn_eps,
                 axis_name=axis_name, act="relu", use_pallas=cfg.use_pallas,
-                labels=bn_labels)
+                labels=bn_labels, pallas_mesh=pallas_mesh)
             if cfg.attn_res == cfg.base_size * (2 ** i):
                 h = attn_apply(attn_params(), h, compute_dtype=cdt,
                                num_heads=cfg.attn_heads,
@@ -234,9 +236,11 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
 
 def sampler_apply(params: Pytree, state: Pytree, z: jax.Array, *,
                   cfg: ModelConfig,
-                  labels: Optional[jax.Array] = None) -> jax.Array:
+                  labels: Optional[jax.Array] = None,
+                  pallas_mesh=None) -> jax.Array:
     """Inference-mode generation (reference `sampler`, distriubted_model.py:131)."""
-    img, _ = generator_apply(params, state, z, cfg=cfg, train=False, labels=labels)
+    img, _ = generator_apply(params, state, z, cfg=cfg, train=False,
+                             labels=labels, pallas_mesh=pallas_mesh)
     return img
 
 
@@ -285,6 +289,7 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
                         labels: Optional[jax.Array] = None,
                         axis_name: Optional[str] = None,
                         attn_mesh=None,
+                        pallas_mesh=None,
                         capture: Optional[dict] = None
                         ) -> Tuple[jax.Array, jax.Array, Pytree]:
     """image [B, S, S, c] -> (sigmoid(logit), logit [B, 1], new_bn_state).
@@ -322,7 +327,7 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
                 params[f"bn{i}"], state[f"bn{i}"], h, train=train,
                 momentum=cfg.bn_momentum, eps=cfg.bn_eps,
                 axis_name=axis_name, act="lrelu", leak=cfg.leak,
-                use_pallas=cfg.use_pallas)
+                use_pallas=cfg.use_pallas, pallas_mesh=pallas_mesh)
         else:
             h = lrelu(h, cfg.leak)
         if cfg.attn_res and cfg.attn_res == cfg.output_size >> (i + 1):

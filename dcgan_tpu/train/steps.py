@@ -143,7 +143,7 @@ class TrainStepFns:
 
 def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                     constrain_fake: Optional[Callable] = None,
-                    attn_mesh=None) -> TrainStepFns:
+                    attn_mesh=None, pallas_mesh=None) -> TrainStepFns:
     """constrain_fake, if given, is applied to every generator output that is
     fed to the discriminator during training. The parallel layer passes a
     `with_sharding_constraint` to the real-image sharding here when the mesh
@@ -204,7 +204,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                   aug_key=None) -> Tuple[jax.Array, Tuple]:
         fake, _ = generator_apply(g_params, bn["gen"], z, cfg=mcfg, train=True,
                                   labels=labels, axis_name=axis_name,
-                                  attn_mesh=attn_mesh)
+                                  attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
         fake = _cf(fake)
         # D sees real then fake, chaining BN state through both applications —
         # the functional analogue of the reference's two discriminator() calls
@@ -213,11 +213,11 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         _, real_logits, d_bn1 = discriminator_apply(
             d_params, bn["disc"], _aug(images, aug_key, 0),
             cfg=mcfg, train=True, labels=labels,
-            axis_name=axis_name, attn_mesh=attn_mesh)
+            axis_name=axis_name, attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
         _, fake_logits, d_bn2 = discriminator_apply(
             d_params, d_bn1, _aug(fake, aug_key, 1),
             cfg=mcfg, train=True, labels=labels,
-            axis_name=axis_name, attn_mesh=attn_mesh)
+            axis_name=axis_name, attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
         d_loss, d_real, d_fake = gan_losses(real_logits, fake_logits)[:3]
         gp = jnp.zeros((), jnp.float32)
         if wgan or r1:
@@ -231,7 +231,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                 return discriminator_apply(
                     d_params, bn["disc"], x, cfg=mcfg, train=False,
                     labels=labels, axis_name=axis_name,
-                    attn_mesh=attn_mesh)[1][:, 0]
+                    attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)[1][:, 0]
             if wgan:
                 gp = L.gradient_penalty(critic, images.astype(jnp.float32),
                                         fake.astype(jnp.float32), gp_key)
@@ -262,14 +262,14 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                                                                Tuple]:
         fake, g_bn = generator_apply(g_params, bn["gen"], z, cfg=mcfg,
                                      train=True, labels=labels,
-                                     axis_name=axis_name, attn_mesh=attn_mesh)
+                                     axis_name=axis_name, attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
         fake = _cf(fake)
         # generator gradients flow THROUGH the augmentation — the property
         # DiffAugment needs (arXiv:2006.10738)
         _, fake_logits, _ = discriminator_apply(
             d_params, bn["disc"], _aug(fake, aug_key, 2), cfg=mcfg,
             train=True, labels=labels, axis_name=axis_name,
-            attn_mesh=attn_mesh)
+            attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
         # the family's own generator loss (4th return) — single-sourced with
         # the D-side dispatch; every family's g_loss depends only on the
         # fake logits, so the real-logits slot gets a dummy (its unused
@@ -380,7 +380,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         g_params = (state["ema_gen"] if cfg.g_ema_decay > 0.0
                     else state["params"]["gen"])
         return sampler_apply(g_params, state["bn"]["gen"], z,
-                             cfg=mcfg, labels=labels)
+                             cfg=mcfg, labels=labels,
+                             pallas_mesh=pallas_mesh)
 
     def summarize(state: Pytree, images: jax.Array, key: jax.Array,
                   labels: Optional[jax.Array] = None) -> dict:
@@ -399,14 +400,25 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                                minval=-1.0, maxval=1.0, dtype=jnp.float32)
         g_cap: dict = {}
         d_cap: dict = {}
-        generator_apply(params["gen"], bn["gen"], z, cfg=mcfg, train=True,
-                        labels=labels, axis_name=axis_name,
-                        attn_mesh=attn_mesh, capture=g_cap)
-        discriminator_apply(params["disc"], bn["disc"], images, cfg=mcfg,
-                            train=True, labels=labels, axis_name=axis_name,
-                            attn_mesh=attn_mesh, capture=d_cap)
+        fake, _ = generator_apply(params["gen"], bn["gen"], z, cfg=mcfg,
+                                  train=True, labels=labels,
+                                  axis_name=axis_name,
+                                  attn_mesh=attn_mesh, pallas_mesh=pallas_mesh, capture=g_cap)
+        d_real_prob, _, _ = discriminator_apply(
+            params["disc"], bn["disc"], images, cfg=mcfg,
+            train=True, labels=labels, axis_name=axis_name,
+            attn_mesh=attn_mesh, pallas_mesh=pallas_mesh, capture=d_cap)
+        # the reference's input/output histogram channels (image_train.py:
+        # 86-89): z itself, D(x), and D(G(z)) — one extra D forward on the
+        # fakes, paid only on the summary cadence
+        d_fake_prob, _, _ = discriminator_apply(
+            params["disc"], bn["disc"], fake, cfg=mcfg,
+            train=True, labels=labels, axis_name=axis_name,
+            attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
         acts = {**{f"gen/{k}": v for k, v in g_cap.items()},
-                **{f"disc/{k}": v for k, v in d_cap.items()}}
+                **{f"disc/{k}": v for k, v in d_cap.items()},
+                "z": z, "d_real_prob": d_real_prob,
+                "d_fake_prob": d_fake_prob}
         return activation_stats(acts, axis_name=axis_name)
 
     def eval_losses(state: Pytree, images: jax.Array, z: jax.Array,

@@ -20,3 +20,14 @@ import jax  # noqa: E402
 # the env var — override it back so tests never dial the real chip.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
+
+
+def pytest_collection_modifyitems(config, items):
+    """Two-tier suite (markers registered in pytest.ini): anything not
+    explicitly marked `slow` is the smoke tier, so `-m smoke` and `-m slow`
+    partition the suite exactly."""
+    import pytest
+
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.smoke)

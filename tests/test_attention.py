@@ -15,6 +15,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # see pytest.ini: excluded from the smoke tier
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig

@@ -75,6 +75,7 @@ class TestPolicies:
 
 
 class TestStepWiring:
+    @pytest.mark.slow
     def test_diffaug_step_runs_and_differs(self):
         """The augmented step trains (finite metrics) and takes a different
         trajectory from the unaugmented one."""

@@ -64,6 +64,7 @@ class TestExampleProto:
         assert out["label"] == [3]
         np.testing.assert_allclose(out["scores"], [0.5, -1.5])
 
+    @pytest.mark.slow
     def test_cross_check_against_tensorflow(self):
         """Our codec must interoperate with the real tf.train.Example."""
         tf = pytest.importorskip("tensorflow")

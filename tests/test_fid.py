@@ -179,6 +179,32 @@ class TestKID:
         # union sample leans toward the larger stream
         assert float(a.features().mean()) > 0.5
 
+    def test_feature_pool_merge_not_early_stream_biased(self):
+        """Merging two at-capacity pools must draw from each side's WHOLE
+        uniform sample, not a stream-ordered prefix (ADVICE r1: the fill
+        phase leaves buffers stream-ordered, so prefix draws skew early).
+        Features encode stream position; the merged mean must sit near the
+        union stream's mean, not the early-stream mean."""
+        from dcgan_tpu.evals.kid import FeaturePool
+
+        a = FeaturePool(1, capacity=200, seed=0)
+        b = FeaturePool(1, capacity=200, seed=1)
+        # both pools exactly at capacity -> buffers are fill-phase ordered,
+        # the worst case for a prefix draw (take=200 of mine+theirs=400)
+        a.update(np.arange(0, 200, dtype=np.float32)[:, None])
+        b.update(np.arange(1000, 1200, dtype=np.float32)[:, None])
+        a.merge(b)
+        assert a.features().shape == (200, 1)
+        # union mean = (99.5 + 1099.5)/2 = 599.5; a prefix-biased draw pulls
+        # each side's early half, giving ~(49.75 + 1049.75)/2 when balanced
+        # but skewing hard whenever p_other streaks — require the mean close
+        # to uniform AND late-stream elements from both sides present
+        feats = a.features().ravel()
+        mine = feats[feats < 1000]
+        theirs = feats[feats >= 1000]
+        assert abs(feats.mean() - 599.5) < 80
+        assert mine.max() > 150 and theirs.max() > 1150  # late tails drawn
+
     def test_compute_fid_with_kid_single_pass(self):
         from dcgan_tpu.config import ModelConfig
         from dcgan_tpu.models import gan_init, sampler_apply

@@ -7,6 +7,8 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # see pytest.ini: excluded from the smoke tier
+
 from dcgan_tpu.config import ModelConfig, TrainConfig
 from dcgan_tpu.generate import build_parser, generate
 from dcgan_tpu.train.trainer import train

@@ -72,6 +72,7 @@ def test_sampler_uses_running_stats():
     assert float(jnp.max(jnp.abs(out_train - out1))) > 1e-4
 
 
+@pytest.mark.slow
 def test_128x128_config():
     cfg = ModelConfig(output_size=128, compute_dtype="float32")
     assert cfg.num_up_layers == 5

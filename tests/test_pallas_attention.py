@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # see pytest.ini: excluded from the smoke tier
+
 from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from dcgan_tpu.ops.attention import attn_apply, attn_init, full_attention
 from dcgan_tpu.ops.pallas_attention import flash_attention

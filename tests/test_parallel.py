@@ -78,14 +78,19 @@ def test_spatial_sharding_rules():
     assert batch_sharding(mesh, 2, spatial=True).spec == P("data", None)
 
 
-@pytest.mark.parametrize("mesh_cfg,model,conditional",
-                         [(MeshConfig(), TINY, False),
-                          (MeshConfig(model=2), TINY, False),
-                          (MeshConfig(model=2, spatial=True), TINY, False),
-                          (MeshConfig(shard_opt=True), TINY, False),
-                          (MeshConfig(), "cbn", True)],
-                         ids=["dp8", "dp4xtp2", "dp4xsp2", "dp8-zero1",
-                              "dp8-cbn"])
+# dp8 is the one sharded-equivalence case kept in the smoke tier; the other
+# partitionings are slow-tier (each is a fresh multi-device compile)
+@pytest.mark.parametrize(
+    "mesh_cfg,model,conditional",
+    [pytest.param(MeshConfig(), TINY, False, id="dp8"),
+     pytest.param(MeshConfig(model=2), TINY, False, id="dp4xtp2",
+                  marks=pytest.mark.slow),
+     pytest.param(MeshConfig(model=2, spatial=True), TINY, False,
+                  id="dp4xsp2", marks=pytest.mark.slow),
+     pytest.param(MeshConfig(shard_opt=True), TINY, False, id="dp8-zero1",
+                  marks=pytest.mark.slow),
+     pytest.param(MeshConfig(), "cbn", True, id="dp8-cbn",
+                  marks=pytest.mark.slow)])
 def test_sharded_step_matches_single_device(mesh_cfg, model, conditional):
     """The sharded SPMD step must be numerically equivalent to the unsharded
     step — data parallelism here is synchronous (one global batch, global BN
@@ -121,6 +126,7 @@ def test_sharded_step_matches_single_device(mesh_cfg, model, conditional):
         <= 2 * cfg.learning_rate + 1e-5
 
 
+@pytest.mark.slow
 def test_multi_step_matches_sequential_steps():
     """multi_step (K steps as one lax.scan program, one dispatch) must equal
     K individual step() calls fed the same keys and batches."""
@@ -148,6 +154,7 @@ def test_multi_step_matches_sequential_steps():
         <= 3 * 2 * cfg.learning_rate + 1e-5
 
 
+@pytest.mark.slow
 def test_sharded_state_placement():
     cfg = TrainConfig(model=TINY, batch_size=16, mesh=MeshConfig(model=2))
     pt = make_parallel_train(cfg)
@@ -160,6 +167,7 @@ def test_sharded_state_placement():
     assert all(s.data.shape == () for s in step.addressable_shards)
 
 
+@pytest.mark.slow
 def test_sharded_sample_and_multiple_steps():
     cfg = TrainConfig(model=TINY, batch_size=16)
     pt = make_parallel_train(cfg)
@@ -173,6 +181,7 @@ def test_sharded_sample_and_multiple_steps():
     assert img.shape == (16, 16, 16, 3)
 
 
+@pytest.mark.slow
 def test_conditional_sharded_step():
     cfg = TrainConfig(
         model=ModelConfig(output_size=16, gf_dim=8, df_dim=8, num_classes=4,
@@ -185,6 +194,7 @@ def test_conditional_sharded_step():
     assert np.isfinite(float(m["d_loss"]))
 
 
+@pytest.mark.slow
 def test_zero1_opt_state_sharding():
     """shard_opt=True (ZeRO-1, arXiv:2004.13336): Adam moments shard over
     the data axis; params/BN stay on their usual rules; the physical shards
@@ -224,6 +234,7 @@ def test_zero1_rejected_for_shard_map_backend():
                     mesh=MeshConfig(shard_opt=True))
 
 
+@pytest.mark.slow
 def test_g_ema_sharded():
     """ema_gen mirrors the generator param paths, so the TP sharding rules
     hit it automatically; one sharded step keeps it consistent."""
@@ -243,6 +254,7 @@ def test_g_ema_sharded():
     assert pt.sample(s, z).shape == (16, 16, 16, 3)
 
 
+@pytest.mark.slow
 def test_wgan_gp_sharded():
     """Grad-of-grad through the GSPMD-sharded mesh (SURVEY.md §7 hard part c)."""
     cfg = TrainConfig(model=TINY, batch_size=16, loss="wgan-gp")

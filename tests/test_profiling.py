@@ -2,6 +2,8 @@
 trainer wiring (SURVEY.md §5 — the tracing/profiling channel the reference
 lacks entirely)."""
 
+import pytest
+
 import glob
 import json
 
@@ -83,6 +85,7 @@ class TestTraceCapture:
 
 
 class TestTrainerWiring:
+    @pytest.mark.slow
     def test_trainer_emits_perf_scalars_and_trace(self, tmp_path):
         from dcgan_tpu.config import ModelConfig, TrainConfig
         from dcgan_tpu.train.trainer import train

@@ -13,6 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # see pytest.ini: excluded from the smoke tier
+
 from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from dcgan_tpu.parallel import make_parallel_train, make_shard_map_train
 from dcgan_tpu.train import make_train_step
@@ -121,13 +123,11 @@ def test_global_histogram_matches_unsharded():
 
 
 def test_pallas_composes_with_data_parallelism():
-    """use_pallas + 8-device DP: rejected under gspmd, works under shard_map
-    (per-shard kernels, explicit moment pmean)."""
+    """use_pallas + 8-device DP under shard_map (per-shard kernels, explicit
+    moment pmean). The gspmd backend composes too since VERDICT r1 #5 —
+    tests/test_pallas.py::TestGspmdComposition covers that side."""
     pallas_model = ModelConfig(output_size=16, gf_dim=8, df_dim=8,
                                compute_dtype="float32", use_pallas=True)
-    with pytest.raises(ValueError, match="shard_map"):
-        make_parallel_train(TrainConfig(model=pallas_model, batch_size=16))
-
     cfg = TrainConfig(model=pallas_model, batch_size=16, backend="shard_map")
     pt = make_shard_map_train(cfg)
     s = pt.init(jax.random.key(0))

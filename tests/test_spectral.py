@@ -145,6 +145,7 @@ class TestSNTraining:
         for v in m.values():
             assert np.isfinite(float(v))
 
+    @pytest.mark.slow
     def test_sharded_sn_step_matches_single_device(self):
         cfg = TrainConfig(model=SN_TINY, batch_size=16, mesh=MeshConfig(),
                           loss="hinge")

@@ -101,6 +101,7 @@ class TestLosses:
 # train step
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 class TestTrainStep:
     def test_step_updates_everything(self):
         fns = make_train_step(tiny_cfg())

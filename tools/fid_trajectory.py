@@ -1,0 +1,162 @@
+"""Surrogate-FID validity experiment + FID/KID training trajectory.
+
+VERDICT r1 #2/#8: the claim that random-feature Fréchet distance tracks true
+FID's *ordering* (evals/features.py:8-13) was asserted, not evidenced. This
+tool produces the evidence: train a GAN, checkpoint at increasing step
+counts, score every checkpoint with the surrogate rig against the SAME data
+stream, and report the trajectory. Validity = the score improves
+(near-)monotonically with training — the property the north star needs
+(ranking checkpoints/trainers), independent of the absolute scale Inception
+features would give.
+
+    # CPU validity run (tiny model, synthetic data, ~minutes)
+    python tools/fid_trajectory.py --platform cpu --tiny \
+        --snapshots 0,50,100,200,400 --num_samples 2048
+
+    # chip run on a real preset (writes one JSON line per snapshot)
+    python tools/fid_trajectory.py --preset cifar10-cond \
+        --snapshots 0,500,2000,5000 --num_samples 10000
+
+Prints one JSON line per snapshot {"step", "fid", ("kid", "kid_std")} plus a
+final {"monotonic": ..., "spearman": ...} summary line. The reference has no
+counterpart (its only eval was eyeballing sample grids, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _spearman(xs, ys) -> float:
+    """Spearman rank correlation (no scipy dependency)."""
+    import numpy as np
+
+    def ranks(v):
+        order = np.argsort(v)
+        r = np.empty(len(v))
+        r[order] = np.arange(len(v), dtype=float)
+        return r
+
+    rx, ry = ranks(np.asarray(xs)), ranks(np.asarray(ys))
+    rx -= rx.mean()
+    ry -= ry.mean()
+    denom = float(np.sqrt((rx ** 2).sum() * (ry ** 2).sum()))
+    return float((rx * ry).sum() / denom) if denom else 0.0
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="fid_trajectory")
+    p.add_argument("--preset", default=None,
+                   help="named config (presets.py); default = tiny/flagship")
+    p.add_argument("--tiny", action="store_true",
+                   help="16x16 gf=df=8 f32 model — the CPU validity config")
+    p.add_argument("--snapshots", default="0,50,100,200,400",
+                   help="comma-joined step counts to score (ascending)")
+    p.add_argument("--num_samples", type=int, default=2048)
+    p.add_argument("--batch_size", type=int, default=64)
+    p.add_argument("--data_dir", default=None,
+                   help="TFRecord shards; default trains/scoreS on the "
+                        "synthetic stream")
+    p.add_argument("--kid", action="store_true")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--platform", default=None)
+    p.add_argument("--out_dir", default=None,
+                   help="keep checkpoints here (default: temp dir)")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import tempfile
+
+    from dcgan_tpu.config import ModelConfig, TrainConfig
+    from dcgan_tpu.evals.job import compute_fid
+    from dcgan_tpu.parallel import make_mesh, make_parallel_train
+    from dcgan_tpu.train.trainer import train
+
+    snapshots = sorted(int(s) for s in args.snapshots.split(","))
+    root = args.out_dir or tempfile.mkdtemp(prefix="fid_traj_")
+
+    if args.preset:
+        from dcgan_tpu.presets import get_preset
+
+        base = get_preset(args.preset)
+    elif args.tiny:
+        base = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8,
+                                             df_dim=8,
+                                             compute_dtype="float32"),
+                           batch_size=args.batch_size)
+    else:
+        base = TrainConfig(batch_size=args.batch_size)
+    cfg = dataclasses.replace(
+        base, checkpoint_dir=f"{root}/ckpt", sample_dir=f"{root}/samples",
+        batch_size=args.batch_size, seed=args.seed,
+        sample_every_steps=0, save_summaries_secs=1e18, save_model_secs=1e18,
+        log_every_steps=0, nan_check_steps=0,
+        data_dir=args.data_dir or base.data_dir)
+    synthetic = args.data_dir is None
+    mcfg = cfg.model
+
+    # One growing run: train to each snapshot in turn (resume-from-latest
+    # carries state forward), scoring a frozen copy of the state at each stop.
+    mesh = make_mesh(cfg.mesh)
+    pt = make_parallel_train(cfg, mesh)
+    scores = []
+    for target in snapshots:
+        if target > 0:
+            state = train(cfg, synthetic_data=synthetic, max_steps=target)
+        else:
+            state = pt.init(jax.random.key(cfg.seed))
+
+        def sample_fn(z, labels=None, _s=state):
+            return pt.sample(_s, z, labels) if labels is not None \
+                else pt.sample(_s, z)
+
+        if synthetic:
+            from dcgan_tpu.data import synthetic_batches
+
+            data = synthetic_batches(args.batch_size, mcfg.output_size,
+                                     mcfg.c_dim, seed=args.seed + 1, pool=0)
+        else:
+            from dcgan_tpu.data import DataConfig, make_dataset
+            from dcgan_tpu.parallel import batch_sharding
+
+            data = make_dataset(
+                DataConfig(data_dir=args.data_dir,
+                           image_size=mcfg.output_size,
+                           channels=mcfg.c_dim, batch_size=args.batch_size,
+                           seed=args.seed, normalize=True),
+                batch_sharding(mesh, 4))
+
+        result = compute_fid(
+            sample_fn, data, image_size=mcfg.output_size, c_dim=mcfg.c_dim,
+            z_dim=mcfg.z_dim, num_samples=args.num_samples,
+            batch_size=args.batch_size, num_classes=mcfg.num_classes,
+            seed=args.seed, kid=args.kid)
+        row = {"step": target, "fid": result["fid"]}
+        if args.kid:
+            row["kid"] = result["kid"]
+            row["kid_std"] = result["kid_std"]
+        scores.append(row)
+        print(json.dumps(row), flush=True)
+
+    fids = [r["fid"] for r in scores]
+    steps = [r["step"] for r in scores]
+    monotonic = all(b <= a for a, b in zip(fids, fids[1:]))
+    print(json.dumps({
+        "monotonic": monotonic,
+        "spearman_steps_vs_fid": round(_spearman(steps, fids), 4),
+        "snapshots": len(scores),
+    }))
+
+
+if __name__ == "__main__":
+    main()
