@@ -249,6 +249,9 @@ class TrainConfig:
     save_model_secs: float = 600.0   # single-process checkpoint cadence
     save_model_steps: int = 1000     # multi-host cadence (collective save
                                      # needs a clock-independent trigger)
+    max_checkpoints: int = 5         # retained checkpoints (Orbax
+                                     # max_to_keep; the reference's Saver
+                                     # default was also 5)
     sample_every_steps: int = 100
     sample_grid: Tuple[int, int] = (8, 8)   # 8x8 grid (image_train.py:205)
     log_every_steps: int = 1

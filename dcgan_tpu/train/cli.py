@@ -110,6 +110,8 @@ def build_parser() -> argparse.ArgumentParser:
                         "(JSONL metrics are always written)")
     p.add_argument("--save_summaries_secs", type=float, default=10.0)
     p.add_argument("--save_model_secs", type=float, default=600.0)
+    p.add_argument("--max_checkpoints", type=int, default=5,
+                   help="checkpoints retained (oldest pruned beyond this)")
     p.add_argument("--sample_every_steps", type=int, default=100)
     p.add_argument("--log_every_steps", type=int, default=1,
                    help="stdout loss-line cadence (1 = the reference's "
@@ -185,6 +187,7 @@ _FLAG_FIELDS = {
     "checkpoint_dir": ("", "checkpoint_dir"), "sample_dir": ("", "sample_dir"),
     "save_summaries_secs": ("", "save_summaries_secs"),
     "save_model_secs": ("", "save_model_secs"),
+    "max_checkpoints": ("", "max_checkpoints"),
     "sample_every_steps": ("", "sample_every_steps"),
     "log_every_steps": ("", "log_every_steps"),
     "activation_summary_steps": ("", "activation_summary_steps"),

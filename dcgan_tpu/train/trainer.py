@@ -120,9 +120,53 @@ def _sample_data_iterator(cfg: TrainConfig, mesh, *,
     return None
 
 
+def _install_stop_handlers():
+    """Graceful shutdown (single-process only): SIGTERM/SIGINT set a flag
+    the hot loop polls, and the loop breaks at the next step boundary to
+    force a final checkpoint — a TPU-VM preemption notice becomes a
+    resumable stop. One-shot: the handler restores default semantics on
+    first delivery so a second signal can still kill a hung final save.
+    Multi-host keeps default signal semantics: save() is a collective, and
+    a handler firing on one process would deadlock the others (the job
+    restarts from the last periodic save — the reference Supervisor's
+    recovery contract, image_train.py:123-141).
+
+    Returns (stop_signal, restore_handlers); the caller restores the
+    originals in a finally block so an exception mid-run cannot leave the
+    flag-only handler installed on a process whose loop is gone."""
+    import signal
+    import threading
+
+    stop_signal = {"num": None}
+    restore_handlers = {}
+    if jax.process_count() == 1 and \
+            threading.current_thread() is threading.main_thread():
+        def _on_signal(signum, frame):
+            stop_signal["num"] = signum
+            for sig, handler in restore_handlers.items():
+                signal.signal(sig, handler)
+
+        for s in (signal.SIGTERM, signal.SIGINT):
+            restore_handlers[s] = signal.signal(s, _on_signal)
+    return stop_signal, restore_handlers
+
+
 def train(cfg: TrainConfig, *, synthetic_data: bool = False,
           max_steps: Optional[int] = None) -> Pytree:
     """Run the training loop; returns the final state pytree."""
+    import signal
+
+    stop_signal, restore_handlers = _install_stop_handlers()
+    try:
+        return _train(cfg, synthetic_data=synthetic_data,
+                      max_steps=max_steps, stop_signal=stop_signal)
+    finally:
+        for s, h in restore_handlers.items():
+            signal.signal(s, h)
+
+
+def _train(cfg: TrainConfig, *, synthetic_data: bool,
+           max_steps: Optional[int], stop_signal: dict) -> Pytree:
     initialize_multihost()
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
@@ -130,7 +174,8 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
 
     ckpt = Checkpointer(cfg.checkpoint_dir,
                         save_interval_secs=cfg.save_model_secs,
-                        save_interval_steps=cfg.save_model_steps)
+                        save_interval_steps=cfg.save_model_steps,
+                        max_to_keep=cfg.max_checkpoints)
 
     # Checkpoints carry their config (VERDICT r1 #3): a resume with a
     # different architecture must fail HERE with a readable message, not
@@ -206,6 +251,11 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
     epoch_size = max(1, _epoch_size(cfg))  # hoisted: reads the manifest once
     step_num = start_step
     while step_num < total_steps:
+        if stop_signal["num"] is not None:
+            if chief:
+                print(f"[dcgan_tpu] received signal {stop_signal['num']} — "
+                      f"checkpointing at step {step_num} and exiting")
+            break
         # steps_per_call > 1: dispatch K steps as one scanned program when
         # aligned to a K boundary with K steps remaining (a checkpoint
         # restore can land mid-boundary; single steps realign, and the
@@ -322,7 +372,11 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
 
     trace.close()
     writer.close()
-    ckpt.save(total_steps, state, force=True)
+    # final forced save at the step actually reached (== total_steps unless
+    # a shutdown signal broke the loop early); skip if the periodic save
+    # already wrote this exact step
+    if ckpt.latest_step() != step_num:
+        ckpt.save(step_num, state, force=True)
     ckpt.wait()
     return state
 
