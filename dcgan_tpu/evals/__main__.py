@@ -65,6 +65,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "--g_ema_decay > 0) instead of the live weights")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--platform", default=None)
+    p.add_argument("--multihost", action="store_true",
+                   help="distributed scoring: initialize jax.distributed, "
+                        "split --num_samples over the processes (each host "
+                        "streams its own shard / z stream), all-gather the "
+                        "statistics; chief prints the JSON line")
     return p
 
 
@@ -77,6 +82,11 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+
+    if args.multihost:
+        from dcgan_tpu.parallel import initialize_multihost
+
+        initialize_multihost()
 
     from dcgan_tpu.config import MODEL_OVERRIDE_FLAGS, TrainConfig, \
         resolve_model_config
@@ -96,7 +106,12 @@ def main(argv: Optional[List[str]] = None) -> None:
         checkpoint_dir=args.checkpoint_dir,
         # any value > 0 makes sample() read state["ema_gen"]
         g_ema_decay=0.999 if args.use_ema else 0.0)
-    mesh = make_mesh(cfg.mesh)
+    # --multihost scores embarrassingly parallel: each process samples its
+    # OWN z stream on its LOCAL devices (a global-mesh sample would be a
+    # collective over one shared z — the wrong program for split scoring);
+    # only the final moment statistics cross processes (job.py allgather)
+    devices = jax.local_devices() if args.multihost else None
+    mesh = make_mesh(cfg.mesh, devices)
     pt = make_parallel_train(cfg, mesh)
 
     state = pt.init(jax.random.key(0))
@@ -110,9 +125,13 @@ def main(argv: Optional[List[str]] = None) -> None:
         from dcgan_tpu.data import synthetic_batches
 
         # pool=0: the real-side statistics need every sample distinct —
-        # cycled batches would bias the FID moments and the KID reservoir
+        # cycled batches would bias the FID moments and the KID reservoir.
+        # Per-process seed offset: under --multihost each process must
+        # stream DIFFERENT reals (its share of the split)
         data = synthetic_batches(args.batch_size, mcfg.output_size,
-                                 mcfg.c_dim, seed=args.seed + 1, pool=0)
+                                 mcfg.c_dim,
+                                 seed=args.seed + 1 + jax.process_index(),
+                                 pool=0)
     else:
         from dcgan_tpu.data import DataConfig, make_dataset
 
@@ -136,9 +155,11 @@ def main(argv: Optional[List[str]] = None) -> None:
         batch_size=args.batch_size, num_classes=mcfg.num_classes,
         seed=args.seed, feature_fn=feature_fn, feature_dim=feature_dim,
         kid=args.kid, kid_subset_size=args.kid_subset_size,
-        kid_subsets=args.kid_subsets, kid_pool_size=args.kid_pool)
+        kid_subsets=args.kid_subsets, kid_pool_size=args.kid_pool,
+        distributed=args.multihost)
     result["step"] = step
-    print(json.dumps(result))
+    if jax.process_index() == 0:
+        print(json.dumps(result))
 
 
 if __name__ == "__main__":

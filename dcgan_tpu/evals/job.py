@@ -75,6 +75,74 @@ def generator_stats(sample_fn: Callable, feature_fn: FeatureFn,
     return stats
 
 
+def _allgather_f64(x: np.ndarray) -> np.ndarray:
+    """process_allgather that PRESERVES float64: device_put canonicalizes
+    f64 -> f32 without jax_enable_x64, which would silently corrupt the
+    moment accumulators (finalize()'s covariance is a cancellation-prone
+    subtraction that needs the full 52-bit mantissa at 50k samples). The
+    array crosses the wire as its uint32 bit pattern instead."""
+    from jax.experimental import multihost_utils as mh
+
+    bits = np.ascontiguousarray(np.asarray(x, np.float64)).view(np.uint32)
+    return np.ascontiguousarray(
+        np.asarray(mh.process_allgather(bits))).view(np.float64)
+
+
+def allgather_merge_stats(stats: StreamingStats) -> StreamingStats:
+    """Cross-process reduction of per-process feature statistics: every
+    process contributes its (n, Σx, Σxxᵀ) accumulators and every process
+    gets the identical global StreamingStats back. No-op single-process."""
+    if jax.process_count() == 1:
+        return stats
+    from jax.experimental import multihost_utils as mh
+
+    merged = StreamingStats(stats.dim)
+    # n fits int32 comfortably (sample budgets are ~1e5), so the default
+    # canonicalization is harmless here
+    merged.n = int(np.sum(mh.process_allgather(np.asarray(stats.n))))
+    merged._sum = np.sum(_allgather_f64(stats._sum), axis=0)
+    merged._outer = np.sum(_allgather_f64(stats._outer), axis=0)
+    return merged
+
+
+def pool_from_features(feats: np.ndarray, n_seen: int, capacity: int, *,
+                       seed: int = 0) -> FeaturePool:
+    """Rebuild a FeaturePool around an existing uniform sample (used to
+    reconstruct remote processes' pools after an allgather)."""
+    pool = FeaturePool(feats.shape[1], capacity, seed=seed)
+    pool._buf[:len(feats)] = feats
+    pool.n_seen = int(n_seen)
+    return pool
+
+
+def allgather_merge_pool(pool: FeaturePool) -> FeaturePool:
+    """Cross-process weighted reservoir merge: gather every process's pool
+    and fold them with FeaturePool.merge. Deterministic given the pool's
+    rng state, so all processes converge on the same merged sample.
+
+    Requires every process to have streamed the same number of examples
+    (the distributed compute_fid splits num_samples evenly), so the
+    gathered buffers have equal shapes.
+    """
+    if jax.process_count() == 1:
+        return pool
+    from jax.experimental import multihost_utils as mh
+
+    feats = mh.process_allgather(pool.features())           # [P, S, D]
+    counts = mh.process_allgather(np.asarray(pool.n_seen))  # [P]
+    counts = counts.reshape(-1)
+    # EVERY process folds in the same order (0, then 1..P-1) with the same
+    # fixed rng — starting from each process's own buffer would swap
+    # mine/theirs in the weighted draws and give per-process results
+    merged = pool_from_features(np.asarray(feats[0]), counts[0],
+                                pool.capacity, seed=0)
+    merged._rng = np.random.default_rng(12345)
+    for p in range(1, feats.shape[0]):
+        merged.merge(pool_from_features(np.asarray(feats[p]), counts[p],
+                                        pool.capacity))
+    return merged
+
+
 def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
                 image_size: int, c_dim: int = 3, z_dim: int = 100,
                 num_samples: int = 50_000, batch_size: int = 256,
@@ -83,7 +151,8 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
                 feature_dim: Optional[int] = None,
                 kid: bool = False, kid_subset_size: int = 1000,
                 kid_subsets: int = 100,
-                kid_pool_size: int = 10_000) -> dict:
+                kid_pool_size: int = 10_000,
+                distributed: bool = False) -> dict:
     """End-to-end scoring: returns {"fid", "num_samples", "feature_dim"} and,
     with kid=True, {"kid", "kid_std"} from the SAME feature pass (a bounded
     reservoir of features feeds the subset-averaged unbiased-MMD estimator —
@@ -92,22 +161,46 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
     With feature_fn=None the fixed-seed random embedder is used — scores are
     then comparable across runs/processes but are surrogate scores, not
     Inception ones (see evals/features.py).
+
+    distributed=True under a jax.distributed job splits num_samples evenly
+    over the processes — each streams its own real-data shard and generates
+    with a process-distinct z stream — then all-gathers the moment
+    accumulators (and KID reservoirs) so every process returns the same
+    global score. There is no multi-eval counterpart in the reference (its
+    only eval was the chief eyeballing sample grids, SURVEY.md §4).
     """
     if feature_fn is None:
         feature_fn, feature_dim = make_random_feature_fn(image_size, c_dim)
     elif feature_dim is None:
         raise ValueError("feature_dim required with a custom feature_fn")
 
+    n_proc = jax.process_count() if distributed else 1
+    local_samples = num_samples // n_proc
+    if distributed and num_samples % n_proc:
+        raise ValueError(
+            f"num_samples ({num_samples}) must divide evenly over "
+            f"{n_proc} processes")
+    # process-distinct generator stream; real-data sharding is the
+    # pipeline's job (per-host shard ownership / per-process seeds)
+    gen_seed = seed + 7919 * (jax.process_index() if distributed else 0)
+
     real_pool = FeaturePool(feature_dim, kid_pool_size, seed=seed) \
         if kid else None
     fake_pool = FeaturePool(feature_dim, kid_pool_size, seed=seed + 1) \
         if kid else None
-    real = stats_from_batches(feature_fn, data_batches, num_samples,
+    real = stats_from_batches(feature_fn, data_batches, local_samples,
                               feature_dim, pool=real_pool)
     fake = generator_stats(sample_fn, feature_fn, feature_dim,
-                           num_samples=num_samples, batch_size=batch_size,
-                           z_dim=z_dim, seed=seed, num_classes=num_classes,
+                           num_samples=local_samples, batch_size=batch_size,
+                           z_dim=z_dim, seed=gen_seed,
+                           num_classes=num_classes,
                            pool=fake_pool)
+    if distributed:
+        real = allgather_merge_stats(real)
+        fake = allgather_merge_stats(fake)
+        if kid:
+            real_pool = allgather_merge_pool(real_pool)
+            fake_pool = allgather_merge_pool(fake_pool)
     fid = frechet_distance(*real.finalize(), *fake.finalize())
     out = {"fid": fid, "num_samples": num_samples,
            "feature_dim": feature_dim}

@@ -1,0 +1,161 @@
+"""Distributed FID/KID scoring: two real OS processes split the sample
+budget, stream independent real/fake shards, and all-gather the moment
+statistics + KID reservoirs into one global score (evals/job.py
+allgather_merge_*). The reference had no eval at all (SURVEY.md §4)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # see pytest.ini: excluded from the smoke tier
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+_WORKER_CODE = """
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+jax.distributed.initialize(coordinator_address=os.environ["MH_COORD"],
+                           num_processes=2,
+                           process_id=int(os.environ["MH_PID"]))
+from dcgan_tpu.evals.__main__ import main
+main(["--checkpoint_dir", os.environ["MH_CKPT"], "--synthetic",
+      "--multihost", "--kid", "--num_samples", "256", "--batch_size", "32",
+      "--kid_pool", "128", "--kid_subset_size", "64", "--kid_subsets", "8"])
+print(f"EVAL_OK pid={jax.process_index()}", flush=True)
+"""
+
+
+class TestDistributedScoring:
+    def test_two_process_eval_matches_contract(self, tmp_path):
+        from dcgan_tpu.config import ModelConfig, TrainConfig
+        from dcgan_tpu.train.trainer import train
+
+        ckpt_dir = str(tmp_path / "ckpt")
+        train(TrainConfig(
+            model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                              compute_dtype="float32"),
+            batch_size=8, checkpoint_dir=ckpt_dir,
+            sample_dir=str(tmp_path / "sm"), sample_every_steps=0,
+            save_summaries_secs=1e9, save_model_secs=1e9,
+            log_every_steps=0), synthetic_data=True, max_steps=1)
+
+        port = _free_port()
+        procs = []
+        for pid in range(2):
+            env = dict(os.environ)
+            env.pop("JAX_COORDINATOR_ADDRESS", None)
+            env.update({"MH_COORD": f"127.0.0.1:{port}",
+                        "MH_PID": str(pid), "MH_CKPT": ckpt_dir,
+                        "PYTHONPATH": _REPO})
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _WORKER_CODE], env=env, cwd=_REPO,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=560)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for p, out in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
+
+        # chief printed the one JSON line; the other process printed none
+        json_lines = [l for l in outs[0].splitlines() if l.startswith("{")]
+        assert len(json_lines) == 1, outs[0][-2000:]
+        result = json.loads(json_lines[0])
+        assert result["num_samples"] == 256          # the GLOBAL budget
+        assert np.isfinite(result["fid"]) and result["fid"] > 0
+        assert np.isfinite(result["kid"])
+        assert result["step"] == 1
+        assert not [l for l in outs[1].splitlines() if l.startswith("{")]
+        assert "EVAL_OK pid=1" in outs[1]
+
+
+class TestMergeHelpers:
+    def test_allgather_passthrough_single_process(self):
+        from dcgan_tpu.evals.fid import StreamingStats
+        from dcgan_tpu.evals.job import allgather_merge_stats
+        from dcgan_tpu.evals.kid import FeaturePool
+        from dcgan_tpu.evals.job import allgather_merge_pool
+
+        stats = StreamingStats(4)
+        stats.update(np.ones((8, 4), np.float32))
+        assert allgather_merge_stats(stats) is stats
+
+        pool = FeaturePool(4, 8)
+        pool.update(np.ones((8, 4), np.float32))
+        assert allgather_merge_pool(pool) is pool
+
+    def test_pool_from_features_round_trip(self):
+        from dcgan_tpu.evals.job import pool_from_features
+
+        feats = np.arange(12, dtype=np.float32).reshape(4, 3)
+        pool = pool_from_features(feats, n_seen=20, capacity=4)
+        np.testing.assert_array_equal(pool.features(), feats)
+        assert pool.n_seen == 20
+
+    def test_uneven_budget_rejected(self, monkeypatch):
+        """distributed num_samples must divide over processes — the guard
+        that keeps the gathered pool buffers equal-shaped."""
+        import jax
+
+        from dcgan_tpu.evals.job import compute_fid
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(jax, "process_index", lambda: 0)
+        with pytest.raises(ValueError, match="divide evenly"):
+            compute_fid(lambda z: z, iter(()), image_size=8, num_samples=7,
+                        batch_size=4, distributed=True)
+
+    def test_f64_gather_preserves_bits(self):
+        """_allgather_f64 must round-trip exact float64 bit patterns
+        (plain process_allgather canonicalizes f64 -> f32)."""
+        from dcgan_tpu.evals.job import _allgather_f64
+
+        x = np.asarray([1.0 + 2 ** -40, np.pi, 1e300], np.float64)
+        out = _allgather_f64(x)  # single-process: leading axis of 1
+        np.testing.assert_array_equal(out.reshape(-1), x)
+        assert out.dtype == np.float64
+
+    def test_split_budget_validated(self):
+        """distributed num_samples must divide over processes; on one
+        process any value divides, so drive the error via the helper's
+        contract directly."""
+        from dcgan_tpu.evals.job import compute_fid
+
+        # single-process distributed=True is legal (n_proc=1) — smoke that
+        # the path works end to end with a trivial sampler
+        import jax.numpy as jnp
+
+        def sample_fn(z):
+            return jnp.zeros((z.shape[0], 8, 8, 3), jnp.float32)
+
+        def data():
+            rng = np.random.default_rng(0)
+            while True:
+                yield jnp.asarray(rng.uniform(-1, 1, (32, 8, 8, 3)),
+                                  jnp.float32)
+
+        out = compute_fid(sample_fn, data(), image_size=8, num_samples=64,
+                          batch_size=32, distributed=True)
+        assert np.isfinite(out["fid"]) and out["fid"] > 0
