@@ -254,6 +254,18 @@ class TrainConfig:
                                      # default was also 5)
     sample_every_steps: int = 100
     sample_grid: Tuple[int, int] = (8, 8)   # 8x8 grid (image_train.py:205)
+    fid_every_steps: int = 0       # >0: periodic in-training surrogate
+                                   # FID/KID probe (evals/ rig) against the
+                                   # held-out sample pipeline — written as
+                                   # eval/fid + eval/kid scalars. Single-process runs
+                                   # only (multi-host scores offline via
+                                   # `evals --multihost`); 0 = off
+                                   # (reference parity: its only eval was
+                                   # the human eyeballing grids)
+    fid_num_samples: int = 2048    # samples per side for the probe (small
+                                   # by design: KID is unbiased at small n,
+                                   # and the probe's job is trend, not the
+                                   # FID-50k headline)
     log_every_steps: int = 1
     nan_check_steps: int = 100     # every N steps all processes verify the
                                    # loss metrics are finite and abort with
@@ -339,6 +351,13 @@ class TrainConfig:
         if not 0.0 <= self.g_ema_decay < 1.0:
             raise ValueError(
                 f"g_ema_decay must be in [0, 1), got {self.g_ema_decay}")
+        if self.fid_every_steps < 0:
+            raise ValueError(
+                f"fid_every_steps must be >= 0, got {self.fid_every_steps}")
+        if self.fid_every_steps and self.fid_num_samples < 64:
+            raise ValueError(
+                f"fid_num_samples must be >= 64 for a meaningful probe, "
+                f"got {self.fid_num_samples}")
         if self.lr_schedule not in ("constant", "linear", "cosine"):
             raise ValueError(f"unknown lr_schedule {self.lr_schedule!r}")
         if self.warmup_steps < 0:
@@ -359,6 +378,7 @@ class TrainConfig:
                 "activation_summary_steps": self.activation_summary_steps,
                 "nan_check_steps": self.nan_check_steps,
                 "save_model_steps": self.save_model_steps,
+                "fid_every_steps": self.fid_every_steps,
             }
             # A cadence that is a multiple of K fires exactly on schedule; a
             # cadence that divides K fires at every call boundary (e.g. the

@@ -152,7 +152,8 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
                 kid: bool = False, kid_subset_size: int = 1000,
                 kid_subsets: int = 100,
                 kid_pool_size: int = 10_000,
-                distributed: bool = False) -> dict:
+                distributed: bool = False,
+                real_side: Optional[tuple] = None) -> dict:
     """End-to-end scoring: returns {"fid", "num_samples", "feature_dim"} and,
     with kid=True, {"kid", "kid_std"} from the SAME feature pass (a bounded
     reservoir of features feeds the subset-averaged unbiased-MMD estimator —
@@ -168,6 +169,12 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
     accumulators (and KID reservoirs) so every process returns the same
     global score. There is no multi-eval counterpart in the reference (its
     only eval was the chief eyeballing sample grids, SURVEY.md §4).
+
+    real_side, if given, is a (StreamingStats, FeaturePool | None) pair of
+    PRECOMPUTED real statistics — the data stream is not touched. Repeated
+    scoring of a fixed real set (the in-training probe) computes it once
+    and amortizes it; the pair must have been built with the same
+    feature_fn and sample budget.
     """
     if feature_fn is None:
         feature_fn, feature_dim = make_random_feature_fn(image_size, c_dim)
@@ -184,22 +191,31 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
     # pipeline's job (per-host shard ownership / per-process seeds)
     gen_seed = seed + 7919 * (jax.process_index() if distributed else 0)
 
-    real_pool = FeaturePool(feature_dim, kid_pool_size, seed=seed) \
-        if kid else None
     fake_pool = FeaturePool(feature_dim, kid_pool_size, seed=seed + 1) \
         if kid else None
-    real = stats_from_batches(feature_fn, data_batches, local_samples,
-                              feature_dim, pool=real_pool)
+    if real_side is not None:
+        real, real_pool = real_side
+        if kid and real_pool is None:
+            raise ValueError("kid=True needs a FeaturePool in real_side")
+    else:
+        real_pool = FeaturePool(feature_dim, kid_pool_size, seed=seed) \
+            if kid else None
+        real = stats_from_batches(feature_fn, data_batches, local_samples,
+                                  feature_dim, pool=real_pool)
     fake = generator_stats(sample_fn, feature_fn, feature_dim,
                            num_samples=local_samples, batch_size=batch_size,
                            z_dim=z_dim, seed=gen_seed,
                            num_classes=num_classes,
                            pool=fake_pool)
     if distributed:
-        real = allgather_merge_stats(real)
+        # a caller-provided real_side is taken as already global — merging
+        # it again would double-count
+        if real_side is None:
+            real = allgather_merge_stats(real)
+            if kid:
+                real_pool = allgather_merge_pool(real_pool)
         fake = allgather_merge_stats(fake)
         if kid:
-            real_pool = allgather_merge_pool(real_pool)
             fake_pool = allgather_merge_pool(fake_pool)
     fid = frechet_distance(*real.finalize(), *fake.finalize())
     out = {"fid": fid, "num_samples": num_samples,

@@ -113,6 +113,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_checkpoints", type=int, default=5,
                    help="checkpoints retained (oldest pruned beyond this)")
     p.add_argument("--sample_every_steps", type=int, default=100)
+    p.add_argument("--fid_every_steps", type=int, default=0,
+                   help=">0: periodic in-training surrogate FID/KID probe "
+                        "against the held-out sample stream (single-process "
+                        "runs; eval/fid + eval/kid scalars); 0 = off")
+    p.add_argument("--fid_num_samples", type=int, default=2048,
+                   help="samples per side for the in-training FID probe")
     p.add_argument("--log_every_steps", type=int, default=1,
                    help="stdout loss-line cadence (1 = the reference's "
                         "every-step log; 0 = off)")
@@ -189,6 +195,8 @@ _FLAG_FIELDS = {
     "save_model_secs": ("", "save_model_secs"),
     "max_checkpoints": ("", "max_checkpoints"),
     "sample_every_steps": ("", "sample_every_steps"),
+    "fid_every_steps": ("", "fid_every_steps"),
+    "fid_num_samples": ("", "fid_num_samples"),
     "log_every_steps": ("", "log_every_steps"),
     "activation_summary_steps": ("", "activation_summary_steps"),
     "profile_dir": ("", "profile_dir"),

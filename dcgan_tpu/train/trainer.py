@@ -168,6 +168,10 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
 def _train(cfg: TrainConfig, *, synthetic_data: bool,
            max_steps: Optional[int], stop_signal: dict) -> Pytree:
     initialize_multihost()
+    if cfg.fid_every_steps and jax.process_count() > 1:
+        raise ValueError(
+            "fid_every_steps is a single-process probe; score multi-host "
+            "runs offline with `python -m dcgan_tpu.evals --multihost`")
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
     chief = is_chief()
@@ -227,13 +231,30 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
 
     data = _data_iterator(cfg, mesh, synthetic=synthetic_data)
     sample_data = _sample_data_iterator(cfg, mesh, synthetic=synthetic_data) \
-        if cfg.sample_every_steps else None
+        if cfg.sample_every_steps or cfg.fid_every_steps else None
     # fixed z for the loss probe, tiled to the probe batch size (the
     # reference feeds the same sample_z every time, image_train.py:77,181)
     eval_z = jax.numpy.resize(sample_z, (cfg.batch_size, cfg.model.z_dim)) \
         if sample_data is not None else None
     base_key = jax.random.key(cfg.seed + 2)
     conditional = cfg.model.num_classes > 0
+
+    # In-training surrogate FID/KID probe (evals/ rig; fid_every_steps > 0).
+    # Single-process only: compute_fid streams host-side and pt.sample is a
+    # mesh collective — splitting the budget mid-training is `evals
+    # --multihost`'s job, offline.
+    fid_feature = None
+    if cfg.fid_every_steps:
+        if sample_data is None:
+            raise ValueError(
+                "fid_every_steps needs a held-out stream: provide "
+                "sample_image_dir (or run synthetic), the same source the "
+                "sample-loss probe uses")
+        from dcgan_tpu.evals.features import make_random_feature_fn
+
+        fid_feature = make_random_feature_fn(cfg.model.output_size,
+                                             cfg.model.c_dim)
+    fid_real_side = None  # (StreamingStats, FeaturePool) after first probe
 
     total_steps = max_steps if max_steps is not None else cfg.max_steps
     start_step = int(jax.device_get(state["step"]))
@@ -365,6 +386,49 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                     writer.write_scalars(
                         new_step,
                         {f"sample/{k}": v for k, v in ev.items()})
+
+        if cfg.fid_every_steps and new_step % cfg.fid_every_steps == 0:
+            from dcgan_tpu.evals.job import (
+                FeaturePool,
+                compute_fid,
+                stats_from_batches,
+            )
+
+            def _sample_fn(z, lbls=None, _s=state):
+                return pt.sample(_s, z, lbls) if lbls is not None \
+                    else pt.sample(_s, z)
+
+            n = cfg.fid_num_samples
+            t_fid = time.time()
+            if fid_real_side is None:
+                # real-side statistics are computed ONCE, at the first
+                # probe: the held-out set is fixed, so re-streaming it each
+                # probe would double probe cost and add real-side sampling
+                # noise to the eval/fid trend
+                reals = (b[0] for b in sample_data) if conditional \
+                    else sample_data
+                r_pool = FeaturePool(fid_feature[1], n, seed=cfg.seed)
+                r_stats = stats_from_batches(fid_feature[0], reals, n,
+                                             fid_feature[1], pool=r_pool)
+                fid_real_side = (r_stats, r_pool)
+            fid_result = compute_fid(
+                _sample_fn, None, image_size=cfg.model.output_size,
+                c_dim=cfg.model.c_dim, z_dim=cfg.model.z_dim,
+                num_samples=n, batch_size=cfg.batch_size,
+                num_classes=cfg.model.num_classes, seed=cfg.seed,
+                feature_fn=fid_feature[0], feature_dim=fid_feature[1],
+                kid=True, kid_subset_size=max(2, min(1000, n // 4)),
+                kid_subsets=20, kid_pool_size=n,
+                real_side=fid_real_side)
+            if chief:
+                print(f"[dcgan_tpu] [fid] step {new_step} "
+                      f"fid {fid_result['fid']:.6f} "
+                      f"kid {fid_result['kid']:.3e} "
+                      f"({n} samples, {time.time() - t_fid:.1f}s)")
+                writer.write_scalars(new_step, {
+                    "eval/fid": fid_result["fid"],
+                    "eval/kid": fid_result["kid"],
+                })
 
         trace.maybe_stop(new_step, sync=metrics)
         ckpt.maybe_save(new_step, state)

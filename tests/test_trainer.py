@@ -346,3 +346,29 @@ print("TRAIN_RETURNED", flush=True)
                             synthetic_data=True, max_steps=step + 2)
         import numpy as np
         assert int(np.asarray(state["step"])) == step + 2
+
+
+@pytest.mark.slow
+class TestFidProbe:
+    """In-training surrogate FID/KID probe (fid_every_steps > 0): eval/fid
+    and eval/kid scalars land at the cadence, computed against the held-out
+    sample stream."""
+
+    def test_probe_writes_scalars(self, tmp_path):
+        cfg = tiny_cfg(tmp_path, sample_every_steps=0, fid_every_steps=2,
+                       fid_num_samples=64, save_summaries_secs=1e9)
+        train(cfg, synthetic_data=True, max_steps=4)
+        events = [json.loads(l) for l in
+                  open(tmp_path / "ckpt" / "events.jsonl")]
+        fids = {e["step"]: e["values"] for e in events
+                if e["kind"] == "scalars" and "eval/fid" in e["values"]}
+        assert set(fids) == {2, 4}
+        for v in fids.values():
+            assert np.isfinite(v["eval/fid"]) and v["eval/fid"] > 0
+            assert np.isfinite(v["eval/kid"])
+
+    def test_probe_multiprocess_rejected(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        cfg = tiny_cfg(tmp_path, fid_every_steps=2, fid_num_samples=64)
+        with pytest.raises(ValueError, match="single-process"):
+            train(cfg, synthetic_data=True, max_steps=2)
