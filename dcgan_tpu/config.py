@@ -27,6 +27,13 @@ class ModelConfig:
     automatically (128x128 config from BASELINE.json uses output_size=128).
     """
 
+    arch: str = "dcgan"            # model family: "dcgan" (the reference's
+                                   # stride-2 5x5 stacks) | "resnet" (the
+                                   # WGAN-GP/SNGAN residual blocks,
+                                   # models/resnet.py — BN-free critic,
+                                   # upsample-conv G). Both scale by
+                                   # base_size*2^k and compose with
+                                   # conditioning/cBN/attention/SN/pallas
     output_size: int = 64          # spatial size of generated images (H == W)
     gf_dim: int = 64               # generator base feature maps
     df_dim: int = 64               # discriminator base feature maps
@@ -78,6 +85,9 @@ class ModelConfig:
                                    # BN moments (ops/spectral.py)
 
     def __post_init__(self):
+        if self.arch not in ("dcgan", "resnet"):
+            raise ValueError(
+                f"arch must be 'dcgan' or 'resnet', got {self.arch!r}")
         n = self.num_up_layers
         if n < 1 or self.base_size * (2 ** n) != self.output_size:
             raise ValueError(
@@ -467,9 +477,9 @@ def load_config(directory: str) -> Optional[TrainConfig]:
 
 # The ModelConfig knobs checkpoint consumers (generate/evals CLIs) expose as
 # override flags — one list so the two parsers cannot drift apart.
-MODEL_OVERRIDE_FLAGS = ("output_size", "c_dim", "z_dim", "gf_dim", "df_dim",
-                        "num_classes", "conditional_bn", "attn_res",
-                        "attn_heads", "spectral_norm")
+MODEL_OVERRIDE_FLAGS = ("arch", "output_size", "c_dim", "z_dim", "gf_dim",
+                        "df_dim", "num_classes", "conditional_bn",
+                        "attn_res", "attn_heads", "spectral_norm")
 
 
 def resolve_model_config(checkpoint_dir: str, *, preset: Optional[str] = None,

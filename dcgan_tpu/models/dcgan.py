@@ -114,6 +114,10 @@ def _cdtype(cfg: ModelConfig):
 
 def generator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
     """Returns (params, bn_state) for the generator."""
+    if cfg.arch == "resnet":
+        from dcgan_tpu.models import resnet
+
+        return resnet.generator_init(key, cfg)
     k = cfg.num_up_layers
     dtype = _dtype(cfg)
     keys = jax.random.split(key, 2 * k + 2)
@@ -174,6 +178,13 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
     (distriubted_model.py:75-80,94-110); callers turn them into
     histogram/sparsity summaries (utils/metrics.py).
     """
+    if cfg.arch == "resnet":
+        from dcgan_tpu.models import resnet
+
+        return resnet.generator_apply(
+            params, state, z, cfg=cfg, train=train, labels=labels,
+            axis_name=axis_name, attn_mesh=attn_mesh,
+            pallas_mesh=pallas_mesh, capture=capture)
     k = cfg.num_up_layers
     cdt = _cdtype(cfg)
     new_state: Pytree = {}
@@ -254,6 +265,10 @@ def discriminator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
     Stage 0 has no BN, matching the reference (distriubted_model.py:118; its
     `d_bn0` is created but never used — SURVEY.md §2.4 #7 — we don't create one).
     """
+    if cfg.arch == "resnet":
+        from dcgan_tpu.models import resnet
+
+        return resnet.discriminator_init(key, cfg)
     k = cfg.num_up_layers
     dtype = _dtype(cfg)
     keys = jax.random.split(key, 2 * k + 2)
@@ -297,6 +312,13 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
     `capture` (dict) receives post-activation tensors "h0".."h{k-1}" plus the
     final "logit" — see generator_apply.
     """
+    if cfg.arch == "resnet":
+        from dcgan_tpu.models import resnet
+
+        return resnet.discriminator_apply(
+            params, state, image, cfg=cfg, train=train, labels=labels,
+            axis_name=axis_name, attn_mesh=attn_mesh,
+            pallas_mesh=pallas_mesh, capture=capture)
     k = cfg.num_up_layers
     cdt = _cdtype(cfg)
     new_state: Pytree = {}

@@ -104,6 +104,19 @@ def sagan64(**overrides) -> TrainConfig:
     return dataclasses.replace(cfg, **overrides)
 
 
+def sngan_cifar10(**overrides) -> TrainConfig:
+    """SNGAN on CIFAR-10 (32x32): the ResNet family's canonical recipe
+    (Miyato et al. 2018, table 3) — residual G/D, norm-free spectrally-
+    normalized critic, hinge loss, Adam(2e-4, β1=0, β2=0.9 -> repo default
+    0.999 kept), 5 critic steps per G step. Beyond-reference model family
+    (models/resnet.py)."""
+    cfg = _build(ModelConfig(arch="resnet", output_size=32,
+                             spectral_norm="d"),
+                 MeshConfig(), batch_size=64, dataset="cifar10",
+                 loss="hinge", learning_rate=2e-4, beta1=0.0, n_critic=5)
+    return dataclasses.replace(cfg, **overrides)
+
+
 PRESETS: Dict[str, Callable[..., TrainConfig]] = {
     "celeba64": celeba64,
     "lsun64-dp8": lsun64_dp8,
@@ -111,6 +124,7 @@ PRESETS: Dict[str, Callable[..., TrainConfig]] = {
     "cifar10-cond": cifar10_cond,
     "wgan-gp": wgan_gp,
     "sagan64": sagan64,
+    "sngan-cifar10": sngan_cifar10,
 }
 
 

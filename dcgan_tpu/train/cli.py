@@ -60,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="one-sided label smoothing: D's real target becomes "
                         "1-eps (gan loss only)")
     # model (image_train.py:15-18 — wired here, unlike the reference)
+    p.add_argument("--arch", choices=["dcgan", "resnet"], default="dcgan",
+                   help="model family: the reference's DCGAN stacks or the "
+                        "WGAN-GP/SNGAN residual blocks")
     p.add_argument("--output_size", type=int, default=64)
     p.add_argument("--c_dim", type=int, default=3)
     p.add_argument("--z_dim", type=int, default=100)
@@ -203,6 +206,7 @@ _FLAG_FIELDS = {
     "profile_start_step": ("", "profile_start_step"),
     "profile_num_steps": ("", "profile_num_steps"),
     "timing_window": ("", "timing_window"), "seed": ("", "seed"),
+    "arch": ("model", "arch"),
     "output_size": ("model", "output_size"), "c_dim": ("model", "c_dim"),
     "z_dim": ("model", "z_dim"), "gf_dim": ("model", "gf_dim"),
     "df_dim": ("model", "df_dim"), "num_classes": ("model", "num_classes"),

@@ -9,11 +9,12 @@ from dcgan_tpu.train.cli import apply_overrides, explicit_flags
 
 class TestPresets:
     def test_all_baseline_configs_named(self):
-        # BASELINE.json lists exactly five configurations; sagan64 is the
-        # beyond-BASELINE attention family (presets.py docstring).
+        # BASELINE.json lists exactly five configurations; sagan64 and
+        # sngan-cifar10 are the beyond-BASELINE attention / resnet families
+        # (presets.py docstrings).
         assert set(PRESETS) == {
             "celeba64", "lsun64-dp8", "dcgan128", "cifar10-cond", "wgan-gp",
-            "sagan64"}
+            "sagan64", "sngan-cifar10"}
 
     def test_celeba64_is_reference_headline(self):
         cfg = get_preset("celeba64")
@@ -51,6 +52,12 @@ class TestPresets:
         assert cfg.loss == "hinge" and cfg.beta1 == 0.0
         assert cfg.d_learning_rate == 4e-4 and cfg.g_learning_rate == 1e-4
         assert cfg.g_ema_decay == 0.999
+
+    def test_sngan_cifar10_recipe(self):
+        cfg = get_preset("sngan-cifar10")
+        assert cfg.model.arch == "resnet" and cfg.model.output_size == 32
+        assert cfg.model.spectral_norm == "d" and cfg.loss == "hinge"
+        assert cfg.n_critic == 5 and cfg.beta1 == 0.0
 
     def test_factory_overrides(self):
         cfg = get_preset("celeba64", batch_size=128, seed=7)
