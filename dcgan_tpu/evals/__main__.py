@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "bytes per side)")
     p.add_argument("--feature_npz", default=None,
                    help="optional trained embedder weights (evals/features.py)")
+    p.add_argument("--real_stats", default=None,
+                   help="cache file for real-side statistics: loaded when "
+                        "present (the real pass is skipped), written after "
+                        "computing otherwise. One file per (dataset, "
+                        "feature config, num_samples); include --kid when "
+                        "writing if KID scoring will ever read it")
     p.add_argument("--use_ema", action="store_true",
                    help="score the EMA generator weights (trained with "
                         "--g_ema_decay > 0) instead of the live weights")
@@ -151,14 +157,17 @@ def main(argv: Optional[List[str]] = None) -> None:
         return pt.sample(state, z, labels) if labels is not None \
             else pt.sample(state, z)
 
-    result = compute_fid(
-        sample_fn, data, image_size=mcfg.output_size, c_dim=mcfg.c_dim,
-        z_dim=mcfg.z_dim, num_samples=args.num_samples,
-        batch_size=args.batch_size, num_classes=mcfg.num_classes,
-        seed=args.seed, feature_fn=feature_fn, feature_dim=feature_dim,
-        kid=args.kid, kid_subset_size=args.kid_subset_size,
-        kid_subsets=args.kid_subsets, kid_pool_size=args.kid_pool,
-        distributed=args.multihost)
+    try:
+        result = compute_fid(
+            sample_fn, data, image_size=mcfg.output_size, c_dim=mcfg.c_dim,
+            z_dim=mcfg.z_dim, num_samples=args.num_samples,
+            batch_size=args.batch_size, num_classes=mcfg.num_classes,
+            seed=args.seed, feature_fn=feature_fn, feature_dim=feature_dim,
+            kid=args.kid, kid_subset_size=args.kid_subset_size,
+            kid_subsets=args.kid_subsets, kid_pool_size=args.kid_pool,
+            distributed=args.multihost, real_cache_path=args.real_stats)
+    except ValueError as e:
+        raise SystemExit(str(e)) from None
     result["step"] = step
     if jax.process_index() == 0:
         print(json.dumps(result))

@@ -88,6 +88,52 @@ def _allgather_f64(x: np.ndarray) -> np.ndarray:
         np.asarray(mh.process_allgather(bits))).view(np.float64)
 
 
+def _norm_npz(path: str) -> str:
+    """np.savez APPENDS '.npz' to extensionless paths; normalize up front so
+    the save path and the existence check can never disagree."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
+def real_side_to_npz(path: str, stats: StreamingStats,
+                     pool: Optional[FeaturePool] = None) -> None:
+    """Persist real-side statistics (raw accumulators, not finalized
+    moments, so merging/extending later stays exact; plus the KID reservoir
+    when present). The standard precomputed-real-statistics pattern of FID
+    tooling: the real pass over 50k images is paid once per dataset, not
+    once per checkpoint."""
+    path = _norm_npz(path)
+    arrays = {"n": np.asarray(stats.n, np.int64), "sum": stats._sum,
+              "outer": stats._outer}
+    if pool is not None:
+        arrays["pool_features"] = pool.features()
+        arrays["pool_n_seen"] = np.asarray(pool.n_seen, np.int64)
+        arrays["pool_capacity"] = np.asarray(pool.capacity, np.int64)
+    np.savez(path, **arrays)
+
+
+def real_side_from_npz(path: str, *, need_pool: bool
+                       ) -> tuple:
+    """Load (StreamingStats, FeaturePool | None) written by
+    real_side_to_npz. Raises if KID is requested but the file carries no
+    reservoir (it was written without kid)."""
+    raw = np.load(_norm_npz(path))
+    dim = int(raw["sum"].shape[0])
+    stats = StreamingStats(dim)
+    stats.n = int(raw["n"])
+    stats._sum = np.asarray(raw["sum"], np.float64)
+    stats._outer = np.asarray(raw["outer"], np.float64)
+    pool = None
+    if "pool_features" in raw:
+        pool = pool_from_features(
+            np.asarray(raw["pool_features"], np.float32),
+            int(raw["pool_n_seen"]), int(raw["pool_capacity"]))
+    if need_pool and pool is None:
+        raise ValueError(
+            f"{path} has no KID reservoir (written without --kid); "
+            "recompute the real statistics with --kid")
+    return stats, pool
+
+
 def allgather_merge_stats(stats: StreamingStats) -> StreamingStats:
     """Cross-process reduction of per-process feature statistics: every
     process contributes its (n, Σx, Σxxᵀ) accumulators and every process
@@ -153,7 +199,8 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
                 kid_subsets: int = 100,
                 kid_pool_size: int = 10_000,
                 distributed: bool = False,
-                real_side: Optional[tuple] = None) -> dict:
+                real_side: Optional[tuple] = None,
+                real_cache_path: Optional[str] = None) -> dict:
     """End-to-end scoring: returns {"fid", "num_samples", "feature_dim"} and,
     with kid=True, {"kid", "kid_std"} from the SAME feature pass (a bounded
     reservoir of features feeds the subset-averaged unbiased-MMD estimator —
@@ -175,6 +222,14 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
     scoring of a fixed real set (the in-training probe) computes it once
     and amortizes it; the pair must have been built with the same
     feature_fn and sample budget.
+
+    real_cache_path names an on-disk cache for the real side (the CLI's
+    --real_stats): loaded when the file exists (with n / feature-dim /
+    reservoir-capacity validation), else the real side is computed here as
+    usual and written there. Keeping this inside compute_fid means the
+    cached and uncached paths share one copy of the real-pass construction
+    (same pool seeding, same trimming). Exclusive with real_side and with
+    distributed (the distributed real pass is a per-process split).
     """
     if feature_fn is None:
         feature_fn, feature_dim = make_random_feature_fn(image_size, c_dim)
@@ -191,6 +246,35 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
     # pipeline's job (per-host shard ownership / per-process seeds)
     gen_seed = seed + 7919 * (jax.process_index() if distributed else 0)
 
+    if real_cache_path:
+        import os
+
+        if real_side is not None:
+            raise ValueError("pass real_side OR real_cache_path, not both")
+        if distributed:
+            raise ValueError(
+                "real_cache_path does not compose with distributed scoring "
+                "(the distributed real pass is a per-process split)")
+        if os.path.exists(_norm_npz(real_cache_path)):
+            real_side = real_side_from_npz(real_cache_path, need_pool=kid)
+            cached, cached_pool = real_side
+            if cached.n != num_samples:
+                raise ValueError(
+                    f"{real_cache_path} holds statistics over {cached.n} "
+                    f"examples but num_samples is {num_samples}; FID sides "
+                    "must match — recompute or adjust num_samples")
+            if cached.dim != feature_dim:
+                raise ValueError(
+                    f"{real_cache_path} has feature dim {cached.dim}, the "
+                    f"current extractor yields {feature_dim} — it was "
+                    "written under a different feature config")
+            if kid and cached_pool.capacity != kid_pool_size:
+                raise ValueError(
+                    f"{real_cache_path} reservoir capacity "
+                    f"{cached_pool.capacity} != kid_pool_size "
+                    f"{kid_pool_size}; KID sides must draw from same-sized "
+                    "reservoirs — recompute or adjust kid_pool")
+
     fake_pool = FeaturePool(feature_dim, kid_pool_size, seed=seed + 1) \
         if kid else None
     if real_side is not None:
@@ -202,6 +286,8 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
             if kid else None
         real = stats_from_batches(feature_fn, data_batches, local_samples,
                                   feature_dim, pool=real_pool)
+        if real_cache_path:
+            real_side_to_npz(real_cache_path, real, real_pool)
     fake = generator_stats(sample_fn, feature_fn, feature_dim,
                            num_samples=local_samples, batch_size=batch_size,
                            z_dim=z_dim, seed=gen_seed,

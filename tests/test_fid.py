@@ -291,3 +291,84 @@ class TestEvalJob:
                                 batch_size=32, z_dim=mcfg.z_dim,
                                 num_classes=4)
         assert stats.n == 96
+
+
+class TestRealStatsCache:
+    """--real_stats cache: pure-numpy round trip (smoke tier; the CLI
+    integration lives in the slow tier's eval tests)."""
+
+    def test_npz_round_trip_exact(self, tmp_path):
+        from dcgan_tpu.evals.fid import StreamingStats
+        from dcgan_tpu.evals.job import (
+            real_side_from_npz,
+            real_side_to_npz,
+        )
+        from dcgan_tpu.evals.kid import FeaturePool
+
+        rng = np.random.default_rng(0)
+        stats = StreamingStats(8)
+        pool = FeaturePool(8, 16, seed=3)
+        feats = rng.normal(size=(40, 8)).astype(np.float32)
+        stats.update(feats)
+        pool.update(feats)
+
+        path = str(tmp_path / "real.npz")
+        real_side_to_npz(path, stats, pool)
+        s2, p2 = real_side_from_npz(path, need_pool=True)
+        assert s2.n == stats.n
+        np.testing.assert_array_equal(s2._sum, stats._sum)
+        np.testing.assert_array_equal(s2._outer, stats._outer)
+        np.testing.assert_array_equal(p2.features(), pool.features())
+        assert p2.n_seen == pool.n_seen
+        # finalized moments identical -> identical FID contribution
+        np.testing.assert_array_equal(s2.finalize()[1], stats.finalize()[1])
+
+    def test_missing_pool_rejected_when_kid(self, tmp_path):
+        from dcgan_tpu.evals.fid import StreamingStats
+        from dcgan_tpu.evals.job import (
+            real_side_from_npz,
+            real_side_to_npz,
+        )
+
+        stats = StreamingStats(4)
+        stats.update(np.ones((4, 4), np.float32))
+        path = str(tmp_path / "nopool.npz")
+        real_side_to_npz(path, stats, None)
+        assert real_side_from_npz(path, need_pool=False)[1] is None
+        with pytest.raises(ValueError, match="no KID reservoir"):
+            real_side_from_npz(path, need_pool=True)
+
+    def test_extensionless_path_round_trips(self, tmp_path):
+        """np.savez appends '.npz' to bare paths; save and load must agree
+        on the final name or the cache never hits."""
+        from dcgan_tpu.evals.fid import StreamingStats
+        from dcgan_tpu.evals.job import real_side_from_npz, real_side_to_npz
+
+        stats = StreamingStats(4)
+        stats.update(np.ones((4, 4), np.float32))
+        bare = str(tmp_path / "celeba_real")      # no extension
+        real_side_to_npz(bare, stats, None)
+        s2, _ = real_side_from_npz(bare, need_pool=False)
+        assert s2.n == 4
+
+    def test_pool_capacity_mismatch_rejected(self, tmp_path):
+        from dcgan_tpu.evals.job import compute_fid, real_side_to_npz
+        from dcgan_tpu.evals.fid import StreamingStats
+        from dcgan_tpu.evals.kid import FeaturePool
+        import jax.numpy as jnp
+
+        stats = StreamingStats(512)
+        stats.update(np.random.default_rng(0).normal(
+            size=(64, 512)).astype(np.float32))
+        pool = FeaturePool(512, 32)
+        pool.update(np.random.default_rng(1).normal(
+            size=(64, 512)).astype(np.float32))
+        path = str(tmp_path / "real.npz")
+        real_side_to_npz(path, stats, pool)
+
+        with pytest.raises(ValueError, match="reservoir capacity"):
+            compute_fid(lambda z: jnp.zeros((z.shape[0], 8, 8, 3)),
+                        iter(()), image_size=8, num_samples=64,
+                        batch_size=32, kid=True, kid_pool_size=16,
+                        kid_subset_size=8, kid_subsets=2,
+                        real_cache_path=path)
