@@ -56,6 +56,8 @@ def main(argv=None) -> None:
                    help="named config (presets.py); default = tiny/flagship")
     p.add_argument("--tiny", action="store_true",
                    help="16x16 gf=df=8 f32 model — the CPU validity config")
+    p.add_argument("--arch", choices=["dcgan", "resnet"], default="dcgan",
+                   help="model family for the --tiny/default configs")
     p.add_argument("--snapshots", default="0,50,100,200,400",
                    help="comma-joined step counts to score (ascending)")
     p.add_argument("--num_samples", type=int, default=2048)
@@ -90,12 +92,13 @@ def main(argv=None) -> None:
 
         base = get_preset(args.preset)
     elif args.tiny:
-        base = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8,
-                                             df_dim=8,
+        base = TrainConfig(model=ModelConfig(arch=args.arch, output_size=16,
+                                             gf_dim=8, df_dim=8,
                                              compute_dtype="float32"),
                            batch_size=args.batch_size)
     else:
-        base = TrainConfig(batch_size=args.batch_size)
+        base = TrainConfig(model=ModelConfig(arch=args.arch),
+                           batch_size=args.batch_size)
     cfg = dataclasses.replace(
         base, checkpoint_dir=f"{root}/ckpt", sample_dir=f"{root}/samples",
         batch_size=args.batch_size, seed=args.seed,
