@@ -6,9 +6,9 @@
         --num_samples 1024 --platform cpu        # smoke run
 
 Prints one JSON line: {"fid": ..., "num_samples": ..., "feature_dim": ...,
-("kid": ..., "kid_std": ...,) "step": ...}. There is no counterpart in the
-reference — its only eval was the human eyeballing the sample grids
-(SURVEY.md §4).
+("kid": ..., "kid_std": ...,) ("precision"/"recall"/"density"/"coverage"
+with --prdc,) "step": ...}. There is no counterpart in the reference — its
+only eval was the human eyeballing the sample grids (SURVEY.md §4).
 """
 
 from __future__ import annotations
@@ -54,6 +54,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--kid", action="store_true",
                    help="also report KID (subset-averaged unbiased MMD^2) "
                         "from the same feature pass")
+    p.add_argument("--prdc", action="store_true",
+                   help="also report precision/recall/density/coverage "
+                        "(k-NN manifolds over the same feature reservoirs) "
+                        "— fidelity and diversity separated. Note: k-NN "
+                        "balls in a 512-d embedding are stringent at small "
+                        "pools; compare values across checkpoints at a "
+                        "fixed (pool, k), don't read absolutes")
+    p.add_argument("--prdc_k", type=int, default=5,
+                   help="k for the k-NN manifold radii (papers' default 5)")
     p.add_argument("--kid_subset_size", type=int, default=1000)
     p.add_argument("--kid_subsets", type=int, default=100)
     p.add_argument("--kid_pool", type=int, default=10_000,
@@ -165,6 +174,7 @@ def main(argv: Optional[List[str]] = None) -> None:
             seed=args.seed, feature_fn=feature_fn, feature_dim=feature_dim,
             kid=args.kid, kid_subset_size=args.kid_subset_size,
             kid_subsets=args.kid_subsets, kid_pool_size=args.kid_pool,
+            prdc=args.prdc, prdc_k=args.prdc_k,
             distributed=args.multihost, real_cache_path=args.real_stats)
     except ValueError as e:
         raise SystemExit(str(e)) from None

@@ -129,8 +129,9 @@ def real_side_from_npz(path: str, *, need_pool: bool
             int(raw["pool_n_seen"]), int(raw["pool_capacity"]))
     if need_pool and pool is None:
         raise ValueError(
-            f"{path} has no KID reservoir (written without --kid); "
-            "recompute the real statistics with --kid")
+            f"{path} has no feature reservoir (it was written without "
+            "--kid/--prdc); recompute the real statistics with the "
+            "reservoir-needing flag set")
     return stats, pool
 
 
@@ -198,13 +199,16 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
                 kid: bool = False, kid_subset_size: int = 1000,
                 kid_subsets: int = 100,
                 kid_pool_size: int = 10_000,
+                prdc: bool = False, prdc_k: int = 5,
                 distributed: bool = False,
                 real_side: Optional[tuple] = None,
                 real_cache_path: Optional[str] = None) -> dict:
     """End-to-end scoring: returns {"fid", "num_samples", "feature_dim"} and,
     with kid=True, {"kid", "kid_std"} from the SAME feature pass (a bounded
     reservoir of features feeds the subset-averaged unbiased-MMD estimator —
-    evals/kid.py).
+    evals/kid.py). prdc=True adds {"precision", "recall", "density",
+    "coverage"} (evals/prdc.py) computed on the same reservoirs — fidelity
+    and diversity separated, where FID/KID compress them into one number.
 
     With feature_fn=None the fixed-seed random embedder is used — scores are
     then comparable across runs/processes but are surrogate scores, not
@@ -256,7 +260,8 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
                 "real_cache_path does not compose with distributed scoring "
                 "(the distributed real pass is a per-process split)")
         if os.path.exists(_norm_npz(real_cache_path)):
-            real_side = real_side_from_npz(real_cache_path, need_pool=kid)
+            real_side = real_side_from_npz(real_cache_path,
+                                           need_pool=kid or prdc)
             cached, cached_pool = real_side
             if cached.n != num_samples:
                 raise ValueError(
@@ -268,22 +273,24 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
                     f"{real_cache_path} has feature dim {cached.dim}, the "
                     f"current extractor yields {feature_dim} — it was "
                     "written under a different feature config")
-            if kid and cached_pool.capacity != kid_pool_size:
+            if (kid or prdc) and cached_pool.capacity != kid_pool_size:
                 raise ValueError(
                     f"{real_cache_path} reservoir capacity "
                     f"{cached_pool.capacity} != kid_pool_size "
-                    f"{kid_pool_size}; KID sides must draw from same-sized "
-                    "reservoirs — recompute or adjust kid_pool")
+                    f"{kid_pool_size}; kid/prdc sides must draw from "
+                    "same-sized reservoirs — recompute or adjust kid_pool")
 
+    need_pools = kid or prdc
     fake_pool = FeaturePool(feature_dim, kid_pool_size, seed=seed + 1) \
-        if kid else None
+        if need_pools else None
     if real_side is not None:
         real, real_pool = real_side
-        if kid and real_pool is None:
-            raise ValueError("kid=True needs a FeaturePool in real_side")
+        if need_pools and real_pool is None:
+            raise ValueError(
+                "kid/prdc need a FeaturePool in real_side")
     else:
         real_pool = FeaturePool(feature_dim, kid_pool_size, seed=seed) \
-            if kid else None
+            if need_pools else None
         real = stats_from_batches(feature_fn, data_batches, local_samples,
                                   feature_dim, pool=real_pool)
         if real_cache_path:
@@ -298,10 +305,10 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
         # it again would double-count
         if real_side is None:
             real = allgather_merge_stats(real)
-            if kid:
+            if need_pools:
                 real_pool = allgather_merge_pool(real_pool)
         fake = allgather_merge_stats(fake)
-        if kid:
+        if need_pools:
             fake_pool = allgather_merge_pool(fake_pool)
     fid = frechet_distance(*real.finalize(), *fake.finalize())
     out = {"fid": fid, "num_samples": num_samples,
@@ -315,4 +322,13 @@ def compute_fid(sample_fn: Callable, data_batches: Iterable, *,
         # the score is computed on at most this many reservoir-sampled
         # features per side — recorded so KID numbers are comparable
         out["kid_pool"] = min(kid_pool_size, num_samples)
+    if prdc:
+        from dcgan_tpu.evals.prdc import prdc as prdc_fn
+
+        out.update(prdc_fn(real_pool.features(), fake_pool.features(),
+                           k=prdc_k))
+        # comparability keys, like kid_pool above: P&R values only compare
+        # across runs at a fixed (pool, k)
+        out["prdc_pool"] = min(kid_pool_size, num_samples)
+        out["prdc_k"] = prdc_k
     return out

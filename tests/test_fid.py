@@ -335,7 +335,7 @@ class TestRealStatsCache:
         path = str(tmp_path / "nopool.npz")
         real_side_to_npz(path, stats, None)
         assert real_side_from_npz(path, need_pool=False)[1] is None
-        with pytest.raises(ValueError, match="no KID reservoir"):
+        with pytest.raises(ValueError, match="no feature reservoir"):
             real_side_from_npz(path, need_pool=True)
 
     def test_extensionless_path_round_trips(self, tmp_path):

@@ -14,7 +14,7 @@ class TestPresets:
         # (presets.py docstrings).
         assert set(PRESETS) == {
             "celeba64", "lsun64-dp8", "dcgan128", "cifar10-cond", "wgan-gp",
-            "sagan64", "sngan-cifar10"}
+            "sagan64", "sagan128", "sngan-cifar10"}
 
     def test_celeba64_is_reference_headline(self):
         cfg = get_preset("celeba64")
@@ -52,6 +52,13 @@ class TestPresets:
         assert cfg.loss == "hinge" and cfg.beta1 == 0.0
         assert cfg.d_learning_rate == 4e-4 and cfg.g_learning_rate == 1e-4
         assert cfg.g_ema_decay == 0.999
+
+    def test_sagan128_long_sequence_demo(self):
+        cfg = get_preset("sagan128")
+        assert cfg.model.output_size == 128 and cfg.model.attn_res == 64
+        # attention stage sequence length = 64*64 = 4096 tokens
+        assert cfg.model.attn_res ** 2 == 4096
+        assert cfg.model.spectral_norm == "gd" and cfg.loss == "hinge"
 
     def test_sngan_cifar10_recipe(self):
         cfg = get_preset("sngan-cifar10")
