@@ -205,6 +205,7 @@ class TestKID:
         assert abs(feats.mean() - 599.5) < 80
         assert mine.max() > 150 and theirs.max() > 1150  # late tails drawn
 
+    @pytest.mark.slow
     def test_compute_fid_with_kid_single_pass(self):
         from dcgan_tpu.config import ModelConfig
         from dcgan_tpu.models import gan_init, sampler_apply

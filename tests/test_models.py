@@ -19,6 +19,7 @@ from dcgan_tpu.models import (
 CFG = ModelConfig(compute_dtype="float32")  # f32 on CPU for numerics
 
 
+@pytest.mark.slow
 def test_generator_output_shape_and_range():
     p, s = generator_init(jax.random.key(0), CFG)
     z = jax.random.uniform(jax.random.key(1), (8, 100), minval=-1, maxval=1)
@@ -29,6 +30,7 @@ def test_generator_output_shape_and_range():
     assert set(s1.keys()) == {"bn0", "bn1", "bn2", "bn3"}
 
 
+@pytest.mark.slow
 def test_generator_batch_size_not_hardcoded():
     """The reference hard-codes batch 64 into every deconv output_shape
     (distriubted_model.py:93-109); ours must follow the input batch."""

@@ -27,6 +27,7 @@ def _z(n=4, dim=100, seed=0):
 
 
 class TestShapes:
+    @pytest.mark.slow
     def test_generator_shapes_and_range(self):
         params, bn = gan_init(jax.random.key(0), TINY)
         img, new_bn = generator_apply(params["gen"], bn["gen"], _z(),
@@ -56,6 +57,7 @@ class TestShapes:
         assert sn_bn["disc"] and all(k.startswith("sn_")
                                      for k in sn_bn["disc"])
 
+    @pytest.mark.slow
     def test_deeper_config_scales(self):
         cfg = dataclasses.replace(TINY, output_size=32)
         params, bn = gan_init(jax.random.key(0), cfg)
@@ -93,6 +95,7 @@ class TestShapes:
 
 
 class TestComposition:
+    @pytest.mark.slow
     def test_conditional_cbn_attention_sn(self):
         """The whole feature matrix at once: conditional + cBN + attention
         + spectral norm on both nets, one train-mode forward each way."""

@@ -26,6 +26,7 @@ def real_batch(n=16, size=16):
 
 
 class TestPowerIteration:
+    @pytest.mark.slow
     def test_converges_to_largest_singular_value(self):
         w = jnp.asarray(np.random.default_rng(0).normal(
             size=(48, 32)).astype(np.float32))
