@@ -255,6 +255,21 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
         fid_feature = make_random_feature_fn(cfg.model.output_size,
                                              cfg.model.c_dim)
     fid_real_side = None  # (StreamingStats, FeaturePool) after first probe
+    fid_best = float("inf")
+    best_ckpt = None      # lazy Checkpointer for checkpoint_dir/best
+    if cfg.fid_every_steps:
+        # resume re-seeds the best score from the persisted record —
+        # otherwise the first post-restart probe (fid < inf) would
+        # OVERWRITE a genuinely better pre-preemption best checkpoint
+        # (max_to_keep=1 deletes it)
+        import json
+
+        try:
+            with open(os.path.join(cfg.checkpoint_dir, "best",
+                                   "score.json")) as f:
+                fid_best = float(json.load(f)["fid"])
+        except (OSError, ValueError, KeyError, TypeError):
+            pass
 
     total_steps = max_steps if max_steps is not None else cfg.max_steps
     start_step = int(jax.device_get(state["step"]))
@@ -429,6 +444,34 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                     "eval/fid": fid_result["fid"],
                     "eval/kid": fid_result["kid"],
                 })
+            # best-checkpoint retention: when the probe improves on the
+            # best FID seen this run, snapshot into checkpoint_dir/best
+            # (its own manager, max_to_keep=1) — training ends with both
+            # the latest state AND the best-scoring one on disk. The
+            # periodic/latest cadence is untouched; single-process by
+            # construction (the probe is).
+            if fid_result["fid"] < fid_best:
+                import json
+
+                fid_best = fid_result["fid"]
+                best_dir = os.path.join(cfg.checkpoint_dir, "best")
+                if best_ckpt is None:
+                    # sync save: each best-save is final before training
+                    # continues, so async machinery would only be joined
+                    best_ckpt = Checkpointer(best_dir, max_to_keep=1,
+                                             async_save=False)
+                    # its own config.json so `generate --checkpoint_dir
+                    # ckpt/best` works zero-flag like any checkpoint dir
+                    save_config(cfg, best_dir)
+                best_ckpt.save(new_step, state, force=True)
+                # persisted score: resume re-seeds fid_best from this
+                tmp = os.path.join(best_dir, "score.json.tmp")
+                with open(tmp, "w") as f:
+                    json.dump({"fid": fid_best, "step": int(new_step)}, f)
+                os.replace(tmp, os.path.join(best_dir, "score.json"))
+                if chief:
+                    print(f"[dcgan_tpu] [fid] new best ({fid_best:.6f}) — "
+                          f"saved {cfg.checkpoint_dir}/best/{new_step}")
 
         trace.maybe_stop(new_step, sync=metrics)
         ckpt.maybe_save(new_step, state)

@@ -367,6 +367,36 @@ class TestFidProbe:
             assert np.isfinite(v["eval/fid"]) and v["eval/fid"] > 0
             assert np.isfinite(v["eval/kid"])
 
+    def test_best_checkpoint_retained(self, tmp_path):
+        """Improving probe scores snapshot into checkpoint_dir/best — the
+        run ends holding both the latest and the best-FID state."""
+        from dcgan_tpu.utils.checkpoint import Checkpointer
+
+        cfg = tiny_cfg(tmp_path, sample_every_steps=0, fid_every_steps=2,
+                       fid_num_samples=64, save_summaries_secs=1e9)
+        train(cfg, synthetic_data=True, max_steps=4)
+        best = Checkpointer(os.path.join(cfg.checkpoint_dir, "best"))
+        step = best.latest_step()
+        assert step in (2, 4)  # whichever probe scored best
+        # and it restores like any checkpoint
+        from dcgan_tpu.parallel import make_mesh, make_parallel_train
+
+        pt = make_parallel_train(cfg, make_mesh(cfg.mesh))
+        restored = best.restore_latest(pt.init(jax.random.key(0)))
+        assert restored is not None
+        assert int(jax.device_get(restored["step"])) == step
+
+        # the score record exists and a resume re-seeds from it: a fresh
+        # run in the same dir must NOT overwrite the best with its first
+        # (worse-than-recorded) probe unless it actually improves
+        score = json.load(open(os.path.join(cfg.checkpoint_dir, "best",
+                                            "score.json")))
+        assert score["step"] == step and np.isfinite(score["fid"])
+        train(cfg, synthetic_data=True, max_steps=6)  # resume 2 more steps
+        score2 = json.load(open(os.path.join(cfg.checkpoint_dir, "best",
+                                             "score.json")))
+        assert score2["fid"] <= score["fid"]  # never regresses
+
     def test_probe_multiprocess_rejected(self, tmp_path, monkeypatch):
         monkeypatch.setattr(jax, "process_count", lambda: 2)
         cfg = tiny_cfg(tmp_path, fid_every_steps=2, fid_num_samples=64)
