@@ -167,66 +167,155 @@ def main() -> None:
           f"d_loss={final_d_loss:.3f}", file=sys.stderr)
 
 
-def _run_with_retry() -> None:
-    """Parent wrapper: run the measurement in a child process, bounded retry.
+def _text(s):
+    return s.decode(errors="replace") if isinstance(s, bytes) else (s or "")
 
-    acquire_devices() already retries backend *init* in-process, but the
-    tunneled transport can also fail mid-run (compile-time UNAVAILABLE,
-    dropped tunnel during a measurement window).  A fresh child process per
-    attempt is immune to any poisoned interpreter state.  Child stdout (the
-    one JSON line) and stderr pass straight through to the driver.
+
+def _probe_once(timeout: float) -> tuple[int | None, str]:
+    """Dial jax.devices() in a throwaway child.
+
+    Returns (returncode, diagnostic tail).  returncode None means the child
+    HUNG past ``timeout`` — the dead-tunnel signature (jax.devices() against
+    a dead tunnel blocks instead of raising; observed all of rounds 1-2).
+    A probe costs seconds when the backend answers (raise or success); only
+    a dead tunnel pays the full timeout.
     """
     import subprocess
 
-    attempts = max(1, int(os.environ.get("BENCH_ATTEMPTS", 3)))
-    # Against a dead tunnel jax.devices() has been observed to HANG (not
-    # raise) — a per-attempt wall clock turns that into a retryable failure.
-    child_timeout = float(os.environ.get("BENCH_CHILD_TIMEOUT", 900))
-    def _text(s):
-        return s.decode(errors="replace") if isinstance(s, bytes) else (s or "")
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            env=dict(os.environ), timeout=timeout,
+            capture_output=True, text=True)
+        return res.returncode, _text(res.stderr)[-400:]
+    except subprocess.TimeoutExpired:
+        return None, f"jax.devices() hung >{timeout:.0f}s (dead tunnel)"
 
-    delay = 5.0
-    rc = 1
-    for i in range(attempts):
-        env = dict(os.environ, BENCH_CHILD="1")
-        # Child stdout is CAPTURED and forwarded only on success: a child
-        # that printed its JSON line and then died/hung must not leave a
-        # stale line ahead of a later attempt's (one-JSON-line contract).
+
+def _run_with_budget() -> None:
+    """Parent wrapper: TOTAL-wall-budgeted probe-then-measure.
+
+    Round 2's lesson (BENCH_r02.json rc=124): a retry harness whose
+    worst-case wall (3 x 900 s) exceeds the driver's own timeout gets
+    killed from outside before it can print its structured error line —
+    the capture design itself guaranteed an empty round whenever the
+    tunnel was dead.  This wrapper inverts the budgeting:
+
+      * ``BENCH_TOTAL_BUDGET`` (default 780 s) is a hard deadline chosen
+        UNDER the driver's wall clock; every path prints the one JSON
+        line (value or structured error) before it expires.
+      * A cheap subprocess ``jax.devices()`` probe (90 s cap — RUNBOOK
+        §0's prescription) runs FIRST; dead-tunnel hangs are burned by
+        the probe loop at 90 s apiece, never by a 900 s measurement
+        child that was doomed from the start.
+      * Once a probe answers, the measurement child gets the remaining
+        budget in one shot.  A fast-failing child (transient UNAVAILABLE
+        at compile) re-enters the probe loop while budget allows; a hung
+        child consumes the budget exactly once.
+
+    Child stdout (the one JSON line) is captured and forwarded only on
+    success so a half-dead child can never leave a stale line ahead of a
+    later attempt's.
+    """
+    import subprocess
+
+    total = float(os.environ.get("BENCH_TOTAL_BUDGET", 780))
+    probe_cap = float(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
+    # Floor for a meaningful measurement window: tunnel compile of the
+    # scanned program is ~40-90 s, measurement adds ~30 s. Below this,
+    # don't bother starting a child that cannot finish.
+    min_measure = float(os.environ.get("BENCH_MIN_MEASURE", 150))
+    margin = 15.0  # teardown + JSON-print reserve
+    deadline = time.monotonic() + total
+
+    def remaining() -> float:
+        return deadline - time.monotonic()
+
+    def fail(msg: str, **extra) -> None:
+        print(json.dumps({
+            "metric": "bench_error", "value": None,
+            "unit": "images/sec/chip", "vs_baseline": None,
+            "error": msg, **extra,
+        }))
+        sys.exit(1)
+
+    on_cpu = os.environ.get("BENCH_PLATFORM") == "cpu"
+    # Floor for launching/retrying a measurement child: CPU children need
+    # only ~30 s, so the TPU floor must not gate CPU smoke retries.
+    measure_floor = 0 if on_cpu else min_measure
+    # Cap on measurement attempts: the wall budget bounds hangs, but a
+    # deterministic fast failure (bad preset, import error) would otherwise
+    # re-run every ~15 s until the whole budget burned.
+    max_measures = max(1, int(os.environ.get("BENCH_MEASURE_ATTEMPTS", 3)))
+    probes = 0
+    measures = 0
+    last_diag = ""
+    rc: int | None = 1
+    while True:
+        # Phase 1: probe until the backend answers. CPU smoke runs skip it
+        # (local CPU init cannot hang).
+        if not on_cpu:
+            fast_fails = 0  # consecutive fast rc!=0 probes (deterministic
+            # failure class — broken install, plugin import error); hangs
+            # (rc None) stay budget-bounded, they ARE the tunnel wait.
+            while True:
+                budget = min(probe_cap, remaining() - margin)
+                if budget <= 5:
+                    fail(f"tunnel never answered within budget "
+                         f"({probes} probes, {measures} measure attempts)",
+                         probes=probes, last=last_diag[-200:])
+                probes += 1
+                rc, last_diag = _probe_once(budget)
+                if rc == 0:
+                    break
+                fast_fails = 0 if rc is None else fast_fails + 1
+                state = "hang" if rc is None else f"rc={rc}"
+                print(f"probe {probes} failed ({state}); "
+                      f"{remaining():.0f}s of budget left", file=sys.stderr)
+                if fast_fails >= 3 or remaining() - margin < min_measure:
+                    fail(f"backend probe failed "
+                         f"({probes} probes, {measures} measure attempts, "
+                         f"last {state})",
+                         probes=probes, last=last_diag[-200:])
+                time.sleep(3)
+
+        # Phase 2: one measurement child with the remaining budget.
+        child_budget = remaining() - margin
+        if child_budget < measure_floor or child_budget <= 5:
+            fail(f"no budget left to measure after {probes} probes",
+                 probes=probes, measures=measures, last=last_diag[-200:])
+        measures += 1
         try:
-            res = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                                 env=env, timeout=child_timeout,
-                                 capture_output=True, text=True)
+            res = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=dict(os.environ, BENCH_CHILD="1"),
+                timeout=child_budget, capture_output=True, text=True)
             rc = res.returncode
             sys.stderr.write(_text(res.stderr))
             if rc == 0:
                 sys.stdout.write(_text(res.stdout))
                 sys.exit(0)
             sys.stderr.write(_text(res.stdout))  # failed child's stdout
+            last_diag = _text(res.stderr)
         except subprocess.TimeoutExpired as te:
-            rc = -1
+            rc = None
             sys.stderr.write(_text(te.stderr))
             sys.stderr.write(_text(te.output))
-            print(f"bench attempt {i + 1}/{attempts} timed out after "
-                  f"{child_timeout:.0f}s", file=sys.stderr)
-        print(f"bench attempt {i + 1}/{attempts} failed (rc={rc})",
-              file=sys.stderr)
-        if i + 1 < attempts:
-            time.sleep(delay)
-            delay = min(delay * 2, 60.0)
-    # Structured one-line JSON error so the round artifact is parseable
-    # even on total failure (VERDICT round 1, item 1).
-    print(json.dumps({
-        "metric": "bench_error",
-        "value": None,
-        "unit": "images/sec/chip",
-        "vs_baseline": None,
-        "error": f"bench failed after {attempts} attempts (last rc={rc})",
-    }))
-    sys.exit(1)
+            last_diag = _text(te.stderr) or "measurement child hung"
+        state = "hang/timeout" if rc is None else f"rc={rc}"
+        print(f"measure attempt {measures} failed ({state}); "
+              f"{remaining():.0f}s of budget left", file=sys.stderr)
+        if measures >= max_measures or remaining() - margin < max(
+                measure_floor, 5):
+            fail(f"measurement failed within budget "
+                 f"({probes} probes, {measures} measure attempts, "
+                 f"last {state})",
+                 probes=probes, measures=measures, last=last_diag[-200:])
+        time.sleep(3)  # then re-probe: the fast failure may be transient
 
 
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD") == "1":
         main()
     else:
-        _run_with_retry()
+        _run_with_budget()

@@ -156,6 +156,22 @@ def main(argv: Optional[List[str]] = None) -> None:
                           image_size=mcfg.output_size, channels=mcfg.c_dim,
                           batch_size=args.batch_size, seed=args.seed,
                           normalize=True)
+        if args.multihost and jax.process_count() > 1:
+            # ADVICE r2: shard_for_process falls back to "everyone reads
+            # everything, seeds differ" when there are fewer shards than
+            # processes — the gathered real moments would then sample with
+            # replacement/duplicates, silently biasing FID. Disjoint real
+            # splits need >= process_count shards (re-shard with
+            # `python -m dcgan_tpu.data.prepare --num_shards N`).
+            from dcgan_tpu.data.pipeline import list_shards
+
+            n_shards = len(list_shards(args.data_dir))
+            if n_shards < jax.process_count():
+                raise SystemExit(
+                    f"--multihost real-data scoring needs at least one "
+                    f"TFRecord shard per process for a disjoint real split: "
+                    f"{n_shards} shard(s) < {jax.process_count()} processes "
+                    f"in {args.data_dir!r}")
         data = make_dataset(dcfg, batch_sharding(mesh, 4))
 
     feature_fn = feature_dim = None

@@ -119,11 +119,15 @@ def sagan128(**overrides) -> TrainConfig:
 
 
 def sngan_cifar10(**overrides) -> TrainConfig:
-    """SNGAN on CIFAR-10 (32x32): the ResNet family's canonical recipe
-    (Miyato et al. 2018, table 3) — residual G/D, norm-free spectrally-
-    normalized critic, hinge loss, Adam(2e-4, β1=0, β2=0.9 -> repo default
-    0.999 kept), 5 critic steps per G step. Beyond-reference model family
-    (models/resnet.py)."""
+    """SNGAN on CIFAR-10 (32x32), after Miyato et al. 2018 (table 3):
+    residual G/D, norm-free spectrally-normalized critic, hinge loss,
+    Adam(2e-4, β1=0), 5 critic steps per G step. Two knowing deviations
+    from the paper, so don't expect paper-exact FID: β2 stays at the repo
+    default 0.999 (paper: 0.9), and the critic architecture differs —
+    models/resnet.py doubles channel width per stage and downsamples in
+    EVERY block (final 4x4 map), where the paper's CIFAR-10 D keeps
+    constant 128-ch blocks with the last two blocks not downsampling
+    (final 8x8 map). Beyond-reference model family (models/resnet.py)."""
     cfg = _build(ModelConfig(arch="resnet", output_size=32,
                              spectral_norm="d"),
                  MeshConfig(), batch_size=64, dataset="cifar10",

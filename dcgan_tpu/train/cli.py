@@ -270,7 +270,20 @@ def main(argv: Optional[List[str]] = None) -> None:
     else:
         from dcgan_tpu.config import load_config
 
+        from dcgan_tpu.utils.checkpoint import has_restorable_checkpoint
+
         saved = load_config(args.checkpoint_dir)
+        if saved is not None and not has_restorable_checkpoint(
+                args.checkpoint_dir):
+            # ADVICE r2: a config.json from a run that died before its first
+            # save must not claim the directory — a fresh launch would
+            # silently inherit the dead run's entire config for every flag
+            # not explicitly passed. The trainer's own arch-mismatch check
+            # applies the same gate.
+            print(f"[dcgan_tpu] ignoring config.json in "
+                  f"{args.checkpoint_dir!r}: no restorable checkpoint step "
+                  f"(stale file from a run that died before its first save)")
+            saved = None
         if saved is not None:
             # Resume adopts the checkpoint's own config (VERDICT r1 #3):
             # only explicitly-passed flags override it, so

@@ -19,6 +19,28 @@ import jax
 Pytree = Any
 
 
+def has_restorable_checkpoint(directory: str) -> bool:
+    """True iff `directory` holds at least one completed Orbax step dir.
+
+    Cheap filesystem check — no CheckpointManager construction (which
+    would spin up async machinery and create the directory as a side
+    effect). Completed Orbax steps are integer-named subdirectories;
+    in-flight temp dirs carry an `.orbax-checkpoint-tmp` suffix and fail
+    the digit test. Gates config.json adoption in the CLI: a stale config
+    from a run that died before its first save must not claim the
+    directory (mirror of the trainer's `latest_step() is not None` gate
+    on the arch-mismatch check).
+    """
+    import os
+
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return False
+    return any(name.isdigit() and os.path.isdir(os.path.join(directory, name))
+               for name in entries)
+
+
 class Checkpointer:
     """save / maybe_save (time-throttled) / restore_latest over a state pytree.
 

@@ -124,6 +124,41 @@ class TestTrainerPersistence:
         with pytest.raises(ValueError, match="gf_dim.*(8, 16)"):
             train(bad, synthetic_data=True, max_steps=1)
 
+    def test_cli_ignores_stale_config_without_checkpoint(self, tmp_path,
+                                                         capsys):
+        """ADVICE r2: a fresh CLI launch into a directory holding only a
+        config.json (dead run, never saved) must NOT adopt it — unpassed
+        flags keep their defaults instead of inheriting the dead run's."""
+        from dcgan_tpu.train.cli import main as cli_main
+
+        stale = _tiny_cfg(tmp_path, z_dim=77)
+        save_config(stale, stale.checkpoint_dir)  # config, no checkpoint
+
+        cli_main(["--checkpoint_dir", stale.checkpoint_dir, "--synthetic",
+                  "--max_steps", "1", "--platform", "cpu",
+                  "--output_size", "16", "--gf_dim", "8", "--df_dim", "8",
+                  "--batch_size", "8",
+                  "--sample_every_steps", "0", "--log_every_steps", "0"])
+        out = capsys.readouterr().out
+        assert "adopted config.json" not in out
+        assert "ignoring config.json" in out
+        # z_dim was not passed: must be the default, not the stale 77
+        assert load_config(stale.checkpoint_dir).model.z_dim == \
+            ModelConfig().z_dim
+
+    def test_has_restorable_checkpoint(self, tmp_path):
+        from dcgan_tpu.utils.checkpoint import has_restorable_checkpoint
+
+        assert not has_restorable_checkpoint(str(tmp_path / "absent"))
+        d = tmp_path / "ckpt"
+        d.mkdir()
+        assert not has_restorable_checkpoint(str(d))
+        (d / "best").mkdir()           # retention subdir, not a step
+        (d / "7.orbax-checkpoint-tmp-123").mkdir()  # in-flight temp
+        assert not has_restorable_checkpoint(str(d))
+        (d / "7").mkdir()              # completed step dir
+        assert has_restorable_checkpoint(str(d))
+
     def test_cli_resume_adopts_config_zero_flags(self, tmp_path, capsys):
         """`dcgan_tpu.train --checkpoint_dir ckpt` with NO architecture
         flags resumes a non-default-architecture run: the CLI adopts the
