@@ -118,10 +118,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sample_every_steps", type=int, default=100)
     p.add_argument("--fid_every_steps", type=int, default=0,
                    help=">0: periodic in-training surrogate FID/KID probe "
-                        "against the held-out sample stream (single-process "
-                        "runs; eval/fid + eval/kid scalars); 0 = off")
+                        "against the held-out sample stream (eval/fid + "
+                        "eval/kid scalars; multihost jobs split the budget "
+                        "per process and gather one global score); 0 = off")
     p.add_argument("--fid_num_samples", type=int, default=2048,
-                   help="samples per side for the in-training FID probe")
+                   help="samples per side for the in-training FID probe "
+                        "(must divide evenly over the process count)")
     p.add_argument("--log_every_steps", type=int, default=1,
                    help="stdout loss-line cadence (1 = the reference's "
                         "every-step log; 0 = off)")

@@ -29,7 +29,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_job(tmp_path, backend: str) -> None:
+def _run_job(tmp_path, backend: str, *, fid: bool = False) -> None:
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -41,6 +41,7 @@ def _run_job(tmp_path, backend: str) -> None:
             "MH_PID": str(pid),
             "MH_DIR": str(tmp_path),
             "MH_BACKEND": backend,
+            "MH_FID": "1" if fid else "0",
             "PYTHONPATH": _REPO,
         })
         procs.append(subprocess.Popen(
@@ -71,6 +72,35 @@ def _run_job(tmp_path, backend: str) -> None:
 
 def test_two_process_gspmd(tmp_path):
     _run_job(tmp_path, "gspmd")
+
+
+def test_two_process_fid_probe_and_best_retention(tmp_path):
+    """Distributed in-training FID probe (VERDICT r2 #5): the budget splits
+    per process through the evals rig's distributed path, the gathered
+    score is identical on every process, eval/fid scalars land in the
+    chief's events, and checkpoint_dir/best holds the best-scoring
+    snapshot + score.json — under a real 2-process job."""
+    import json
+
+    _run_job(tmp_path, "gspmd", fid=True)
+
+    ckpt_dir = tmp_path / "ckpt"
+    # chief wrote eval/fid + eval/kid scalars for the probe steps (2, 4)
+    events = [json.loads(l) for l in
+              (ckpt_dir / "events.jsonl").read_text().splitlines()]
+    fid_events = [e for e in events if "eval/fid" in e.get("values", {})]
+    fid_steps = sorted(e["step"] for e in fid_events)
+    assert fid_steps, [e.get("values") for e in events[:8]]
+    assert set(fid_steps) <= {2, 4}
+    # best-checkpoint retention under multihost: a step dir + score record
+    best = ckpt_dir / "best"
+    assert (best / "score.json").exists()
+    score = json.loads((best / "score.json").read_text())
+    assert (best / str(score["step"])).exists()
+    assert (best / "config.json").exists()
+    # the retained score matches one of the probed eval/fid values
+    probed = {round(e["values"]["eval/fid"], 6) for e in fid_events}
+    assert round(score["fid"], 6) in probed
 
 
 @pytest.mark.skipif(os.environ.get("DCGAN_TPU_FULL_MH") != "1",

@@ -397,8 +397,13 @@ class TestFidProbe:
                                              "score.json")))
         assert score2["fid"] <= score["fid"]  # never regresses
 
-    def test_probe_multiprocess_rejected(self, tmp_path, monkeypatch):
+    def test_probe_multiprocess_needs_even_split(self, tmp_path,
+                                                 monkeypatch):
+        """The probe now RUNS under multihost (VERDICT r2 #5, the real
+        2-process exercise is tests/test_multihost.py) — but the sample
+        budget must divide evenly over the processes, validated at
+        startup, not at the first probe step."""
         monkeypatch.setattr(jax, "process_count", lambda: 2)
-        cfg = tiny_cfg(tmp_path, fid_every_steps=2, fid_num_samples=64)
-        with pytest.raises(ValueError, match="single-process"):
+        cfg = tiny_cfg(tmp_path, fid_every_steps=2, fid_num_samples=65)
+        with pytest.raises(ValueError, match="divide evenly"):
             train(cfg, synthetic_data=True, max_steps=2)

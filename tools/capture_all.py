@@ -1,0 +1,308 @@
+"""One-command live-tunnel harvester (VERDICT r2 #2).
+
+The TPU tunnel works in bursts; every live window must yield everything.
+This runs the runbook's sections in priority order — headline bench →
+preset/variant matrix → attention crossovers → chip FID trajectory →
+loader ceiling — each under its own bounded timeout, records every
+result (value or failure) to ``tools/captures.jsonl``, and rewrites the
+marker-delimited "Chip captures" blocks in BASELINE.md and DESIGN.md §8
+from the accumulated log. Dead-tunnel steps are skipped cleanly: one
+failed probe parks all remaining tunnel-bound sections (re-run on the
+next burst; the JSONL is append-only, renders keep the best row per
+label).
+
+Usage:
+    python tools/capture_all.py                  # everything, priority order
+    python tools/capture_all.py --only headline matrix
+    python tools/capture_all.py --render-only    # just re-render the docs
+
+The workload anchor for the throughput sections is the reference's hot
+loop, image_train.py:147-194; the FID section replaces its eval duty
+(image_train.py:179-192).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPTURES = os.path.join(REPO, "tools", "captures.jsonl")
+BASELINE_MD = os.path.join(REPO, "BASELINE.md")
+DESIGN_MD = os.path.join(REPO, "docs", "DESIGN.md")
+
+BEGIN = "<!-- capture_all:begin -->"
+END = "<!-- capture_all:end -->"
+
+
+def _today() -> str:
+    return datetime.date.today().isoformat()
+
+
+def probe(timeout: float = 60.0) -> bool:
+    """RUNBOOK §0: jax.devices() in a throwaway child; hang == dead."""
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            env=dict(os.environ), timeout=timeout, capture_output=True)
+        return res.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Step table: (section, label, argv, env overrides, timeout_s, needs_tunnel)
+# Priority order IS file order — the headline number first, because a burst
+# may die at any moment.
+# ---------------------------------------------------------------------------
+
+def _bench(label: str, timeout: float = 420, **env: str):
+    # bench.py probes for itself too; keep its internal budget under ours
+    # and its probe short (the harvester just probed).
+    e = {"BENCH_TOTAL_BUDGET": str(int(timeout - 30)),
+         "BENCH_PROBE_TIMEOUT": "45", **env}
+    return ("matrix", label, [sys.executable, "bench.py"], e, timeout, True)
+
+
+STEPS = [
+    ("headline", "dcgan64-headline", [sys.executable, "bench.py"],
+     {"BENCH_TOTAL_BUDGET": "570", "BENCH_PROBE_TIMEOUT": "45"}, 600, True),
+    _bench("dcgan128", BENCH_PRESET="dcgan128"),
+    _bench("wgan-gp", BENCH_PRESET="wgan-gp"),
+    _bench("cifar10-cond", BENCH_PRESET="cifar10-cond"),
+    _bench("sngan-cifar10", BENCH_PRESET="sngan-cifar10"),
+    _bench("sagan64-attn", BENCH_ATTN="1"),
+    _bench("sagan64-attn-sn", BENCH_ATTN="1", BENCH_SN="1"),
+    _bench("dcgan64-pallas", BENCH_PALLAS="1"),
+    _bench("dcgan64-shard_map", BENCH_BACKEND="shard_map"),
+    ("attention", "attn-crossover-small",
+     [sys.executable, "tools/bench_attention.py",
+      "--seq", "1024", "4096", "16384"], {}, 600, True),
+    ("attention", "attn-crossover-wall",
+     [sys.executable, "tools/bench_attention.py",
+      "--seq", "32768", "65536"], {}, 600, True),
+    ("fid", "fid-trajectory-chip",
+     [sys.executable, "tools/fid_trajectory.py", "--preset", "cifar10-cond",
+      "--snapshots", "0,500,2000,5000", "--num_samples", "10000", "--kid"],
+     {}, 1800, True),
+    ("loader", "loader-ceiling", [sys.executable, "tools/bench_loader.py"],
+     {}, 900, False),
+]
+
+
+def run_step(section, label, argv, env, timeout, record):
+    t0 = time.monotonic()
+    row = {"date": _today(), "section": section, "label": label,
+           "cmd": " ".join(argv)}
+    try:
+        res = subprocess.run(argv, cwd=REPO, env=dict(os.environ, **env),
+                             timeout=timeout, capture_output=True, text=True)
+        row["rc"] = res.returncode
+        row["stderr_tail"] = (res.stderr or "")[-600:]
+        parsed = []
+        for line in (res.stdout or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    parsed.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass
+        row["parsed"] = parsed
+        m = re.search(r"ms_per_step=([0-9.]+)", res.stderr or "")
+        if m:
+            row["ms_per_step"] = float(m.group(1))
+    except subprocess.TimeoutExpired:
+        row["rc"] = None
+        row["parsed"] = []
+        row["stderr_tail"] = f"timed out after {timeout:.0f}s"
+    row["elapsed_s"] = round(time.monotonic() - t0, 1)
+    record(row)
+    ok = row["rc"] == 0
+    print(f"[capture_all] {label}: "
+          f"{'ok' if ok else 'FAILED (' + str(row['rc']) + ')'} "
+          f"in {row['elapsed_s']}s", file=sys.stderr)
+    return ok, row
+
+
+# ---------------------------------------------------------------------------
+# Rendering: captures.jsonl -> marker-delimited doc blocks
+# ---------------------------------------------------------------------------
+
+def _load_captures():
+    rows = []
+    if os.path.exists(CAPTURES):
+        with open(CAPTURES) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    return rows
+
+
+def _best_bench_rows(rows):
+    """Best successful value per label (the tunnel swings 30%+ run-to-run;
+    steady-state capability is the best capture, matching bench.py's own
+    best-of-windows policy)."""
+    best = {}
+    for r in rows:
+        if r["section"] not in ("headline", "matrix") or r["rc"] != 0:
+            continue
+        for p in r.get("parsed", []):
+            if p.get("value") is None:
+                continue
+            cur = best.get(r["label"])
+            if cur is None or p["value"] > cur["value"]:
+                best[r["label"]] = {
+                    "value": p["value"], "unit": p.get("unit", ""),
+                    "vs": p.get("vs_baseline"), "metric": p.get("metric", ""),
+                    "ms": r.get("ms_per_step"), "date": r["date"]}
+    return best
+
+
+def _attention_rows(rows):
+    """Latest result per (form, seq): ms or the error row (an allocation
+    failure IS the measurement — the dense wall)."""
+    out = {}
+    for r in rows:
+        if r["section"] != "attention":
+            continue
+        for p in r.get("parsed", []):
+            if "form" in p and "seq" in p:
+                out[(p["form"], p["seq"])] = dict(p, date=r["date"])
+    return out
+
+
+def _render_block(path, block_lines):
+    with open(path) as f:
+        text = f.read()
+    block = BEGIN + "\n" + "\n".join(block_lines) + "\n" + END
+    if BEGIN in text:
+        # repl as a callable: captured error text may contain backslash
+        # sequences re.sub would misread as replacement escapes
+        text = re.sub(re.escape(BEGIN) + r".*?" + re.escape(END),
+                      lambda m: block, text, flags=re.S)
+    else:
+        text = text.rstrip() + "\n\n" + block + "\n"
+    with open(path, "w") as f:
+        f.write(text)
+
+
+def render_docs() -> None:
+    rows = _load_captures()
+
+    bench = _best_bench_rows(rows)
+    lines = ["## Chip captures (tools/capture_all.py)", ""]
+    if bench:
+        lines += ["Best successful capture per config (the tunnel's "
+                  "throughput swings run-to-run; see README \"Benchmarks\" "
+                  "for methodology):", "",
+                  "| Config | images/sec/chip | ms/step | vs baseline | "
+                  "captured |", "|---|---|---|---|---|"]
+        for label in sorted(bench):
+            b = bench[label]
+            ms = f"{b['ms']:.2f}" if b.get("ms") else "—"
+            vs = f"{b['vs']:.2f}×" if b.get("vs") is not None else "—"
+            lines.append(f"| {label} | {b['value']} | {ms} | {vs} | "
+                         f"{b['date']} |")
+    else:
+        lines += ["No successful chip captures yet (tunnel down every "
+                  "attempt so far — every attempt is logged in "
+                  "`tools/captures.jsonl`)."]
+    loader = [(p, r["date"]) for r in rows
+              if r["section"] == "loader" and r["rc"] == 0
+              for p in r["parsed"] if "images_per_sec" in p]
+    if loader:
+        # best capture, like the bench rows: the 1-core host swings 30%+
+        # run-to-run (and harvests often share the core with other work)
+        peak, date = max(loader, key=lambda v: v[0]["images_per_sec"])
+        lines += ["", f"Loader re-check (best capture, {date}): "
+                  f"{peak['images_per_sec']:.0f} img/s "
+                  f"({peak.get('threads', '?')} threads, "
+                  f"{peak.get('record_dtype', '?')})."]
+    _render_block(BASELINE_MD, lines)
+
+    attn = _attention_rows(rows)
+    lines = ["### Measured attention crossovers (chip)", ""]
+    if attn:
+        lines += ["| Form | S | ms (fwd+bwd) | status | captured |",
+                  "|---|---|---|---|---|"]
+        for (form, seq) in sorted(attn, key=lambda k: (k[1], k[0])):
+            p = attn[(form, seq)]
+            if "ms" in p:
+                lines.append(f"| {form} | {seq} | {p['ms']:.2f} | ok | "
+                             f"{p['date']} |")
+            else:
+                lines.append(f"| {form} | {seq} | — | "
+                             f"{p.get('error', 'failed')} | {p['date']} |")
+    else:
+        lines += ["Chip pending — the tunnel has not answered during a "
+                  "capture window yet. CPU-side scaling evidence is in the "
+                  "table above; `python tools/capture_all.py` harvests this "
+                  "table on the next live burst."]
+    _render_block(DESIGN_MD, lines)
+    print(f"[capture_all] rendered {len(bench)} bench row(s), "
+          f"{len(attn)} attention row(s)", file=sys.stderr)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--only", nargs="+", default=None,
+                   help="run only these sections "
+                        "(headline matrix attention fid loader)")
+    p.add_argument("--skip", nargs="+", default=[],
+                   help="skip these sections")
+    p.add_argument("--probe_timeout", type=float, default=60.0)
+    p.add_argument("--render-only", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.render_only:
+        render_docs()
+        return
+
+    os.makedirs(os.path.dirname(CAPTURES), exist_ok=True)
+
+    def record(row):
+        with open(CAPTURES, "a") as f:
+            f.write(json.dumps(row) + "\n")
+
+    tunnel_ok: bool | None = None  # None = not yet probed
+    ran = failures = 0
+    for section, label, argv_, env, timeout, needs_tunnel in STEPS:
+        if args.only and section not in args.only:
+            continue
+        if section in args.skip:
+            continue
+        if needs_tunnel:
+            if tunnel_ok is None:
+                print(f"[capture_all] probing tunnel "
+                      f"({args.probe_timeout:.0f}s cap)...", file=sys.stderr)
+                tunnel_ok = probe(args.probe_timeout)
+                print(f"[capture_all] tunnel "
+                      f"{'LIVE' if tunnel_ok else 'dead'}", file=sys.stderr)
+            if not tunnel_ok:
+                record({"date": _today(), "section": section, "label": label,
+                        "cmd": " ".join(argv_), "rc": None, "parsed": [],
+                        "stderr_tail": "skipped: tunnel dead at probe",
+                        "elapsed_s": 0.0, "skipped": True})
+                print(f"[capture_all] {label}: skipped (tunnel dead)",
+                      file=sys.stderr)
+                continue
+        ok, row = run_step(section, label, argv_, env, timeout, record)
+        ran += 1
+        if not ok:
+            failures += 1
+            if needs_tunnel:
+                tunnel_ok = None  # burst may have died: re-probe next step
+    render_docs()
+    print(f"[capture_all] done: {ran} step(s) run, {failures} failed",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
