@@ -1,0 +1,172 @@
+"""CPU-side attention scaling evidence (VERDICT r2 #7, DESIGN.md §8).
+
+The chip crossover table needs live TPU time; this collects what a CPU host
+CAN honestly measure so §8's table has evidence while the chip column stays
+pending:
+
+  * dense forward+backward wall-clock vs S (the O(S^2) growth shape);
+  * the dense allocation wall (at S=65536 the [S,S] score matrix plus
+    backward residuals exceed what this host can allocate — the same
+    failure mode as one chip's HBM, at a host-sized threshold);
+  * the collective structure of the two sequence-parallel forms, counted
+    as op definitions in the OPTIMIZED HLO over an 8-virtual-device mesh:
+    ring lowers to 2 static collective-permutes (the k and v rotations)
+    inside the scanned hop body, each executed n-1 times at runtime
+    (arXiv:2310.01889's neighbor hops); ulysses to 4 all-to-alls forward —
+    one per q/k/v seq->head redistribute plus one head->seq for the output
+    (arXiv:2309.14509's structure) — and zero all-gathers in either form.
+    This pins the communication design the chip table would time.
+
+Prints one JSON line per row. Flash interpret-mode timings are deliberately
+NOT reported: interpret mode executes the kernel's block loop in Python, so
+its wall clock measures the interpreter, not the kernel (memory truth —
+no [S,S] materialization — still holds and is asserted by the test suite).
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import re
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq", type=int, nargs="+",
+                   default=[1024, 4096, 16384])
+    p.add_argument("--wall_seq", type=int, default=65536,
+                   help="S at which to demonstrate the dense allocation "
+                        "wall (0 = skip)")
+    p.add_argument("--mesh", type=int, default=8)
+    p.add_argument("--d", type=int, default=64)
+    p.add_argument("--steps", type=int, default=5)
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from dcgan_tpu.ops.attention import (
+        full_attention,
+        ring_attention,
+        ulysses_attention,
+    )
+
+    scale = args.d ** -0.5
+
+    def qkv(S, heads=1):
+        ks = jax.random.split(jax.random.key(0), 3)
+        return tuple(jax.random.normal(k, (heads, S, args.d), jnp.bfloat16)
+                     for k in ks)
+
+    def grad_step(fn):
+        return jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+
+    # 1. dense wall-clock growth (forward+backward)
+    for S in args.seq:
+        q, k, v = qkv(S)
+        step = grad_step(lambda q, k, v: full_attention(q, k, v, scale=scale))
+        out = step(q, k, v)
+        jax.block_until_ready(out)
+        dt = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                out = step(q, k, v)
+            jax.block_until_ready(out)
+            dt = min(dt, time.perf_counter() - t0)
+        print(json.dumps({"row": "dense_cpu_ms", "seq": S,
+                          "ms": round(dt / args.steps * 1e3, 1)}))
+
+    # 2. the dense allocation wall
+    if args.wall_seq:
+        S = args.wall_seq
+        try:
+            q, k, v = qkv(S)
+            step = grad_step(
+                lambda q, k, v: full_attention(q, k, v, scale=scale))
+            jax.block_until_ready(step(q, k, v))
+            print(json.dumps({"row": "dense_wall", "seq": S,
+                              "result": "unexpectedly succeeded"}))
+        except Exception as e:
+            print(json.dumps({"row": "dense_wall", "seq": S,
+                              "result": f"{type(e).__name__}",
+                              "detail": str(e)[:160]}))
+
+    # 3. collective structure of the sequence-parallel forms (optimized HLO)
+    n = args.mesh
+    mesh = Mesh(np.asarray(jax.devices()[:n]).reshape(1, n),
+                ("data", "model"))
+    spec = P("data", "model", None)
+    S = 1024
+    heads = n
+    q, k, v = qkv(S, heads)
+
+    def count(fn, *xs):
+        # Count op DEFINITIONS: a def line is `%name = <type> <opcode>(...)`
+        # — match the opcode immediately followed by its operand paren on a
+        # line with ` = `. Result NAMES often echo the opcode
+        # (%all-to-all.5) but not always (%ppermute.7 = ...
+        # collective-permute(...)), and uses appear as `(%name)` with no
+        # trailing paren — this pattern counts exactly the defs either way.
+        txt = jax.jit(fn).lower(*xs).compile().as_text()
+
+        def defs(op):
+            return sum(1 for line in txt.splitlines()
+                       if " = " in line
+                       and re.search(rf"{op}(?:-start)?\(", line))
+
+        return {
+            "collective_permute": defs("collective-permute"),
+            "all_to_all": defs("all-to-all"),
+            "all_gather": defs("all-gather"),
+        }
+
+    ring = jax.shard_map(
+        functools.partial(ring_attention, axis_name="model", n_shards=n,
+                          scale=scale),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+    uly = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name="model", n_shards=n,
+                          num_heads=heads, scale=scale),
+        mesh=mesh, in_specs=(P("data", "model", None),) * 3,
+        out_specs=P("data", "model", None))
+    # ring/ulysses operate on [B, S, D]-like shards; reuse the bench's
+    # shapes: [batch*heads, S, d] for ring, [B, S, h*d] for ulysses
+    for name, fn, xs, expect in [
+        ("ring", ring, (q, k, v),
+         "2 static permutes (k and v rotation) inside the scanned hop "
+         f"body, each executed n-1={n - 1} times at runtime"),
+        ("ulysses", uly,
+         tuple(x.transpose(1, 0, 2).reshape(1, S, heads * args.d)
+               for x in (q, k, v)),
+         "4 fwd ops: one seq->head all_to_all per q/k/v + one head->seq "
+         "for the output (arXiv:2309.14509's 4-collective structure)"),
+    ]:
+        fwd = count(fn, *xs)
+        g = jax.grad(lambda *a: jnp.sum(fn(*a).astype(jnp.float32)),
+                     argnums=(0, 1, 2))
+        fwdbwd = count(g, *xs)
+        print(json.dumps({"row": f"{name}_collectives", "mesh": n,
+                          "seq": S, "forward": fwd,
+                          "forward_backward": fwdbwd,
+                          "design": expect}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,176 @@
+"""Real-data end-to-end training throughput (VERDICT r2 #4).
+
+Measures the disk -> TFRecord loader -> device training path the reference
+was built for (image_input.py:98-143) against the synthetic-stream rate on
+the SAME compiled program, so the output is directly the input-bound ratio
+(Weak #4): a procedurally generated PNG corpus goes through the real
+`data.prepare` converter into TFRecord shards (float64 — reference parity —
+and uint8), then the flagship config trains from the real loader while the
+step program, sync discipline (value readback, bench.py's rationale) and
+batch shape stay identical to the synthetic measurement.
+
+Prints one JSON line per measured source:
+  {"metric": "...", "source": "synthetic"|"float64"|"uint8",
+   "value": img/s, "unit": "images/sec", "vs_synthetic": ratio}
+
+Corpus/records are cached under tools/_realdata/ (gitignored; delete to
+regenerate). CPU smoke: --platform cpu --steps 30 --batch 8. The chip run
+is a capture_all.py step.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+ROOT = os.path.join(REPO, "tools", "_realdata")
+
+
+def ensure_corpus(n_images: int, side: int = 108, seed: int = 0) -> str:
+    """Procedural PNG corpus: smooth random gradients + shapes (statistics
+    non-trivial enough that crop/resize/normalize do real work; the POINT is
+    the disk->loader->chip path, not the dataset)."""
+    from PIL import Image
+
+    d = os.path.join(ROOT, f"corpus_{n_images}x{side}")
+    marker = os.path.join(d, ".complete")
+    if os.path.exists(marker):
+        return d
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:side, 0:side].astype(np.float32) / side
+    for i in range(n_images):
+        a, b, c = rng.uniform(-3, 3, 3)
+        base = np.stack([np.sin(a * xx + b * yy + c + ch) for ch in range(3)],
+                        -1)
+        cx, cy, r = rng.uniform(0.2, 0.8, 2).tolist() + [rng.uniform(.05, .3)]
+        mask = ((xx - cx) ** 2 + (yy - cy) ** 2 < r * r)[..., None]
+        img = np.where(mask, rng.uniform(-1, 1, 3).astype(np.float32), base)
+        img = img + rng.normal(0, 0.05, img.shape).astype(np.float32)
+        arr = np.clip((img * 0.5 + 0.5) * 255, 0, 255).astype(np.uint8)
+        Image.fromarray(arr).save(os.path.join(d, f"{i:06d}.png"))
+    with open(marker, "w") as f:
+        f.write("ok")
+    return d
+
+
+def ensure_records(corpus: str, dtype: str, image_size: int) -> str:
+    from dcgan_tpu.data.prepare import convert
+
+    # keyed by the corpus dir name too (it encodes count x side), so a
+    # changed --corpus_images never silently reuses stale records
+    out = os.path.join(ROOT, f"recs_{os.path.basename(corpus)}"
+                             f"_{dtype}_{image_size}")
+    if os.path.exists(os.path.join(out, "dataset.json")):
+        return out
+    convert(corpus, out, image_size=image_size, crop_size=108,
+            record_dtype=dtype, overwrite=True)
+    return out
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--preset", default="celeba64",
+                   help="named config to bench (the flagship real-data run)")
+    p.add_argument("--batch", type=int, default=64, help="per-chip batch")
+    p.add_argument("--steps", type=int, default=200,
+                   help="measured steps per source")
+    p.add_argument("--warmup", type=int, default=8,
+                   help="warmup steps per source (min 1: the first call "
+                        "compiles and must stay out of the timed window)")
+    p.add_argument("--corpus_images", type=int, default=2048)
+    p.add_argument("--dtypes", nargs="+", default=["float64", "uint8"],
+                   help="record dtypes to measure (float64 = reference "
+                        "parity; uint8 = the steered fast path)")
+    p.add_argument("--platform", default=None)
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from dcgan_tpu.config import MeshConfig
+    from dcgan_tpu.data import DataConfig, make_dataset
+    from dcgan_tpu.parallel import batch_sharding, make_mesh, \
+        make_parallel_train
+    from dcgan_tpu.presets import get_preset
+
+    n_chips = len(jax.devices())
+    cfg = dataclasses.replace(get_preset(args.preset),
+                              batch_size=args.batch * n_chips,
+                              mesh=MeshConfig())
+    size = cfg.model.output_size
+    mesh = make_mesh(cfg.mesh)
+    pt = make_parallel_train(cfg, mesh)
+    state = pt.init(jax.random.key(0))
+    base = jax.random.key(1)
+
+    corpus = ensure_corpus(args.corpus_images)
+
+    args.warmup = max(1, args.warmup)
+
+    def measure(batches, tag, state):
+        """Warmup + timed steps over `batches`; value-readback sync."""
+        it = iter(batches)
+        for i in range(args.warmup):
+            state, metrics = pt.step(state, next(it),
+                                     jax.random.fold_in(base, i))
+        float(metrics["d_loss"])
+        t0 = time.perf_counter()
+        for i in range(args.steps):
+            state, metrics = pt.step(state, next(it),
+                                     jax.random.fold_in(base, 1000 + i))
+        float(metrics["d_loss"])  # hard sync ends the window
+        dt = time.perf_counter() - t0
+        rate = cfg.batch_size * args.steps / dt
+        print(f"{tag}: {rate:.1f} img/s ({dt:.2f}s for {args.steps} steps)",
+              file=sys.stderr)
+        return rate, state
+
+    # Synthetic ceiling first: one in-memory batch re-fed every step (the
+    # loader entirely out of the picture), same program.
+    imgs = jnp.asarray(np.random.default_rng(0).uniform(
+        -1, 1, (cfg.batch_size, size, size, cfg.model.c_dim))
+        .astype(np.float32))
+
+    def constant_batches():
+        while True:
+            yield imgs
+
+    syn_rate, state = measure(constant_batches(), "synthetic", state)
+    print(json.dumps({
+        "metric": f"{args.preset} train throughput (batch {args.batch}/chip)",
+        "source": "synthetic", "value": round(syn_rate, 1),
+        "unit": "images/sec", "vs_synthetic": 1.0}))
+
+    for dtype in args.dtypes:
+        recs = ensure_records(corpus, dtype, size)
+        dcfg = DataConfig(data_dir=recs, image_size=size,
+                          channels=cfg.model.c_dim,
+                          batch_size=cfg.batch_size, record_dtype=dtype,
+                          min_after_dequeue=min(1024, args.corpus_images),
+                          n_threads=cfg.num_loader_threads,
+                          seed=0, normalize=True)
+        data = make_dataset(dcfg, batch_sharding(mesh, 4))
+        rate, state = measure(data, f"real {dtype}", state)
+        print(json.dumps({
+            "metric": f"{args.preset} train throughput "
+                      f"(batch {args.batch}/chip)",
+            "source": dtype, "value": round(rate, 1),
+            "unit": "images/sec",
+            "vs_synthetic": round(rate / syn_rate, 3)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -91,6 +91,8 @@ STEPS = [
      [sys.executable, "tools/fid_trajectory.py", "--preset", "cifar10-cond",
       "--snapshots", "0,500,2000,5000", "--num_samples", "10000", "--kid"],
      {}, 1800, True),
+    ("realdata", "realdata-celeba64",
+     [sys.executable, "tools/bench_realdata.py"], {}, 1200, True),
     ("loader", "loader-ceiling", [sys.executable, "tools/bench_loader.py"],
      {}, 900, False),
 ]
@@ -214,6 +216,18 @@ def render_docs() -> None:
         lines += ["No successful chip captures yet (tunnel down every "
                   "attempt so far — every attempt is logged in "
                   "`tools/captures.jsonl`)."]
+    realdata = [r for r in rows
+                if r["section"] == "realdata" and r["rc"] == 0
+                and r.get("parsed")]
+    if realdata:
+        last = realdata[-1]  # latest complete run (rows are a matched set)
+        lines += ["", f"Real-data loader-vs-chip balance "
+                  f"(tools/bench_realdata.py, {last['date']}):", "",
+                  "| Source | img/s | vs synthetic |", "|---|---|---|"]
+        for p in last["parsed"]:
+            if "source" in p:
+                lines.append(f"| {p['source']} | {p['value']} | "
+                             f"{p.get('vs_synthetic', '—')} |")
     loader = [(p, r["date"]) for r in rows
               if r["section"] == "loader" and r["rc"] == 0
               for p in r["parsed"] if "images_per_sec" in p]
@@ -254,7 +268,7 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--only", nargs="+", default=None,
                    help="run only these sections "
-                        "(headline matrix attention fid loader)")
+                        "(headline matrix attention fid realdata loader)")
     p.add_argument("--skip", nargs="+", default=[],
                    help="skip these sections")
     p.add_argument("--probe_timeout", type=float, default=60.0)
