@@ -216,6 +216,17 @@ class TrainConfig:
                                      # semantics); "fused": both grads from the same
                                      # params, applied together (reference parity,
                                      # SURVEY.md §2.4 #2, image_train.py:156-158)
+    grad_accum: int = 1            # microbatches per optimizer update (beyond
+                                   # reference). K>1 scans K microbatches of
+                                   # batch_size/K through each loss at fixed
+                                   # params, accumulating gradients, then
+                                   # applies each Adam once — the full-batch
+                                   # mean gradient at ~1/K the activation
+                                   # memory. BN statistics are per-microbatch
+                                   # with state chained (standard large-batch
+                                   # emulation semantics, not bitwise equal to
+                                   # one full-batch BN pass). Requires
+                                   # n_critic=1.
     diffaug: str = ""              # differentiable augmentation policy for
                                    # every D input (DiffAugment,
                                    # arXiv:2006.10738): comma-joined subset
@@ -408,6 +419,19 @@ class TrainConfig:
             raise ValueError(
                 "update_mode='fused' (reference-parity single fused step) is "
                 "defined only for n_critic=1")
+        if self.grad_accum < 1:
+            raise ValueError(
+                f"grad_accum must be >= 1, got {self.grad_accum}")
+        if self.batch_size % self.grad_accum:
+            raise ValueError(
+                f"batch_size ({self.batch_size}) must be a multiple of "
+                f"grad_accum ({self.grad_accum}) — microbatches are "
+                "batch_size/grad_accum")
+        if self.grad_accum > 1 and self.n_critic > 1:
+            raise ValueError(
+                "grad_accum > 1 composes with n_critic=1 only (the scanned "
+                "critic loop already bounds memory per critic iteration; "
+                "accumulating inside it is not implemented)")
 
 
 # --------------------------------------------------------------------------

@@ -120,25 +120,42 @@ def make_parallel_train(cfg: TrainConfig,
     # attention over the "model" axis (shard_map nested in the jitted step)
     # instead of letting the partitioner all-gather k/v (ops/attention.py).
     attn_mesh = mesh if (spatial and cfg.model.attn_res) else None
-    fns = make_train_step(cfg, constrain_fake=constrain_fake,
-                          attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
-
-    state_shapes = jax.eval_shape(fns.init, jax.random.key(0))
-    shardings = state_shardings(state_shapes, mesh, spatial=spatial,
-                                shard_opt=cfg.mesh.shard_opt)
     rep = replicated(mesh)
     z_sh = batch_sharding(mesh, 2)
     lbl_sh = batch_sharding(mesh, 1)
-    conditional = cfg.model.num_classes > 0
-
-    init = jax.jit(fns.init, out_shardings=shardings)
-
-    multi_body = make_multi_step_body(fns.train_step)
 
     # scanned-batch shardings: step axis in front, batch sharded on axis 1
     def _scan_sh(base):
         return jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec(None, *base.spec))
+
+    constrain_micro = None
+    if cfg.grad_accum > 1:
+        # Pin the step's (grad_accum, micro, ...) input reshapes to
+        # scan-axis-in-front shardings: left alone the partitioner may keep
+        # the "data" sharding on the leading (scan) axis after the reshape,
+        # which serializes the accumulation loop across the mesh. Rank
+        # disambiguates the three step inputs (images 5d / z 3d / labels 2d).
+        _micro_sh = {5: _scan_sh(img_sh), 3: _scan_sh(z_sh),
+                     2: _scan_sh(lbl_sh)}
+
+        def constrain_micro(x):
+            sh = _micro_sh.get(x.ndim)
+            return x if sh is None else \
+                jax.lax.with_sharding_constraint(x, sh)
+
+    fns = make_train_step(cfg, constrain_fake=constrain_fake,
+                          constrain_micro=constrain_micro,
+                          attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
+
+    state_shapes = jax.eval_shape(fns.init, jax.random.key(0))
+    shardings = state_shardings(state_shapes, mesh, spatial=spatial,
+                                shard_opt=cfg.mesh.shard_opt)
+    conditional = cfg.model.num_classes > 0
+
+    init = jax.jit(fns.init, out_shardings=shardings)
+
+    multi_body = make_multi_step_body(fns.train_step)
 
     if conditional:
         step = jax.jit(

@@ -63,6 +63,13 @@ def make_shard_map_train(cfg: TrainConfig,
         raise ValueError(
             f"global batch {cfg.batch_size} must divide over "
             f"{n_shards} data shards")
+    if cfg.grad_accum > 1 and (cfg.batch_size // cfg.grad_accum) % n_shards:
+        # inside shard_map the accumulation reshape is per-device, so each
+        # device's local batch must itself split into grad_accum microbatches
+        raise ValueError(
+            f"microbatch {cfg.batch_size // cfg.grad_accum} "
+            f"(batch_size/grad_accum) must divide over {n_shards} data "
+            "shards")
 
     fns = make_train_step(cfg, axis_name=DATA_AXIS)
     conditional = cfg.model.num_classes > 0

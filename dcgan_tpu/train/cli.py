@@ -41,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
                    default="sequential")
     p.add_argument("--n_critic", type=int, default=1,
                    help="D updates per G update (WGAN-GP canonical: 5)")
+    p.add_argument("--grad_accum", type=int, default=1,
+                   help=">1 scans that many microbatches per optimizer "
+                        "update (full-batch gradient at 1/K activation "
+                        "memory; batch_size must divide by it)")
     p.add_argument("--gp_weight", type=float, default=10.0,
                    help="WGAN-GP gradient-penalty coefficient")
     p.add_argument("--r1_gamma", type=float, default=0.0,
@@ -182,7 +186,8 @@ _FLAG_FIELDS = {
     "learning_rate": ("", "learning_rate"), "beta1": ("", "beta1"),
     "batch_size": ("", "batch_size"), "max_steps": ("", "max_steps"),
     "loss": ("", "loss"), "update_mode": ("", "update_mode"),
-    "n_critic": ("", "n_critic"), "gp_weight": ("", "gp_weight"),
+    "n_critic": ("", "n_critic"), "grad_accum": ("", "grad_accum"),
+    "gp_weight": ("", "gp_weight"),
     "r1_gamma": ("", "r1_gamma"), "r1_interval": ("", "r1_interval"),
     "grad_clip": ("", "grad_clip"), "diffaug": ("", "diffaug"),
     "label_smoothing": ("", "label_smoothing"),

@@ -143,6 +143,7 @@ class TrainStepFns:
 
 def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                     constrain_fake: Optional[Callable] = None,
+                    constrain_micro: Optional[Callable] = None,
                     attn_mesh=None, pallas_mesh=None) -> TrainStepFns:
     """constrain_fake, if given, is applied to every generator output that is
     fed to the discriminator during training. The parallel layer passes a
@@ -153,6 +154,11 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
     the shared conv-kernel gradients (observed ~2x grads on the 8-device CPU
     mesh; the constraint restores f64-level agreement — see
     tests/test_parallel.py::test_sharded_step_matches_single_device[dp4xsp2]).
+
+    constrain_micro, if given, pins the (grad_accum, micro, ...) reshapes of
+    the step inputs to scan-over-microbatches shardings (leading axis
+    unsharded, batch sharded on axis 1) — without it the partitioner may
+    shard the scan axis after the reshape, serializing the mesh.
     """
     mcfg = cfg.model
     opt_g = make_optimizer(cfg, cfg.g_learning_rate)   # TTUR-capable:
@@ -278,6 +284,107 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         g_loss = gan_losses(fake_logits, fake_logits)[3]
         return g_loss, (g_bn,)
 
+    def _ema_update(state: Pytree, new_gen: Pytree) -> Pytree:
+        d_ema = cfg.g_ema_decay  # 0 -> ema_gen mirrors the live weights
+        return jax.tree_util.tree_map(
+            lambda e, p: d_ema * e + (1.0 - d_ema) * p,
+            state["ema_gen"], new_gen)
+
+    def _accum_train_step(state: Pytree, images: jax.Array, z: jax.Array,
+                          gp_key, aug_key, labels) -> Tuple[Pytree, dict]:
+        """grad_accum > 1: K scanned microbatches per optimizer update.
+
+        Gradients for each net are taken at the same (pre-update) params
+        on every microbatch and averaged — the full-batch mean gradient at
+        one microbatch's activation memory. BN state chains through the
+        microbatches exactly as it chains through consecutive steps; the
+        single pmean/all-reduce per net happens on the AVERAGED gradient,
+        so the collective cost per optimizer update is unchanged.
+        """
+        K = cfg.grad_accum
+        micro = images.shape[0] // K
+        params, bn = state["params"], state["bn"]
+
+        def _cm(x):
+            return constrain_micro(x) if constrain_micro is not None else x
+
+        def _split(x):
+            return _cm(x.reshape(K, micro, *x.shape[1:]))
+
+        xs = {"img": _split(images), "z": _split(z),
+              "gpk": jax.random.split(gp_key, K)}
+        if labels is not None:
+            xs["lbl"] = _split(labels)
+        if aug_key is not None:
+            xs["augk"] = jax.random.split(aug_key, K)
+
+        def _zeros_f32(tree):
+            # accumulate in f32 whatever the param dtype: K bf16 adds would
+            # round away low-magnitude contributions
+            return jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+
+        def _acc(acc, grads):
+            return jax.tree_util.tree_map(
+                lambda a, g: a + g.astype(jnp.float32), acc, grads)
+
+        def _avg(acc, like):
+            return _pmean(jax.tree_util.tree_map(
+                lambda a, p: (a / K).astype(p.dtype), acc, like))
+
+        # --- D: one Adam apply from K accumulated microbatch grads ----------
+        def d_micro(carry, x):
+            g_acc, bn_d = carry
+            bn_in = {"gen": bn["gen"], "disc": bn_d}
+            (d_loss, (d_bn_i, d_real, d_fake, gp)), grads = \
+                jax.value_and_grad(d_loss_fn, has_aux=True)(
+                    params["disc"], params["gen"], bn_in, x["img"], x["z"],
+                    x["gpk"], x.get("lbl"), state["step"], False,
+                    x.get("augk"))
+            return (_acc(g_acc, grads), d_bn_i), (d_loss, d_real, d_fake, gp)
+
+        (d_gacc, d_bn), (d_losses, d_reals, d_fakes, gps) = lax.scan(
+            d_micro, (_zeros_f32(params["disc"]), bn["disc"]), xs)
+        d_grads = _avg(d_gacc, params["disc"])
+        d_updates, d_opt = opt_d.update(d_grads, state["opt"]["disc"],
+                                        params["disc"])
+        new_disc = optax.apply_updates(params["disc"], d_updates)
+
+        if cfg.update_mode == "sequential":
+            g_target_disc, disc_bn_for_g = new_disc, d_bn
+        else:  # "fused": G grads at pre-update D params (reference parity)
+            g_target_disc, disc_bn_for_g = params["disc"], bn["disc"]
+
+        # --- G: same accumulation against the (possibly updated) D ----------
+        def g_micro(carry, x):
+            g_acc, bn_g = carry
+            bn_in = {"gen": bn_g, "disc": disc_bn_for_g}
+            (g_loss, (g_bn_i,)), grads = \
+                jax.value_and_grad(g_loss_fn, has_aux=True)(
+                    params["gen"], g_target_disc, bn_in, x["z"],
+                    x.get("lbl"), x.get("augk"))
+            return (_acc(g_acc, grads), g_bn_i), g_loss
+
+        (g_gacc, g_bn), g_losses = lax.scan(
+            g_micro, (_zeros_f32(params["gen"]), bn["gen"]), xs)
+        g_grads = _avg(g_gacc, params["gen"])
+        g_updates, g_opt = opt_g.update(g_grads, state["opt"]["gen"],
+                                        params["gen"])
+        new_gen = optax.apply_updates(params["gen"], g_updates)
+
+        new_state = {
+            "params": {"gen": new_gen, "disc": new_disc},
+            "bn": {"gen": g_bn, "disc": d_bn},
+            "opt": {"gen": g_opt, "disc": d_opt},
+            "step": state["step"] + 1,
+        }
+        new_state["ema_gen"] = _ema_update(state, new_gen)
+        # metrics: microbatch means — the accumulation analogue of the
+        # non-accum path's full-batch values
+        return new_state, _loss_metrics(d_losses.mean(), d_reals.mean(),
+                                        d_fakes.mean(), g_losses.mean(),
+                                        gps.mean())
+
     def train_step(state: Pytree, images: jax.Array, key: jax.Array,
                    labels: Optional[jax.Array] = None
                    ) -> Tuple[Pytree, dict]:
@@ -291,6 +398,10 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         z = jax.random.uniform(
             z_key, (images.shape[0], mcfg.z_dim),
             minval=-1.0, maxval=1.0, dtype=jnp.float32)
+
+        if cfg.grad_accum > 1:
+            return _accum_train_step(state, images, z, gp_key, aug_key,
+                                     labels)
 
         params, bn = state["params"], state["bn"]
 
@@ -364,10 +475,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             # §2.4 #3), this counts full D+G steps.
             "step": state["step"] + 1,
         }
-        d_ema = cfg.g_ema_decay  # 0 -> ema_gen mirrors the live weights
-        new_state["ema_gen"] = jax.tree_util.tree_map(
-            lambda e, p: d_ema * e + (1.0 - d_ema) * p,
-            state["ema_gen"], new_gen)
+        new_state["ema_gen"] = _ema_update(state, new_gen)
         return new_state, _loss_metrics(d_loss, d_real, d_fake, g_loss, gp)
 
     def sample(state: Pytree, z: jax.Array,

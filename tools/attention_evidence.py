@@ -37,10 +37,6 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=8")
-
-
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--seq", type=int, nargs="+",
@@ -52,6 +48,12 @@ def main() -> None:
     p.add_argument("--d", type=int, default=64)
     p.add_argument("--steps", type=int, default=5)
     args = p.parse_args()
+
+    # before the first jax import, so --mesh sizes beyond the default 8
+    # actually get that many virtual host devices
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.mesh}")
 
     import jax
 

@@ -110,6 +110,13 @@ def main() -> None:
     cfg = dataclasses.replace(get_preset(args.preset),
                               batch_size=args.batch * n_chips,
                               mesh=MeshConfig())
+    if cfg.model.num_classes > 0:
+        # the procedural corpus is unlabeled and measure() feeds no labels
+        # arg — a conditional step would fail its in_shardings arity before
+        # measuring anything
+        p.error(f"--preset {args.preset} is class-conditional; this bench "
+                "drives the unconditional real-data path (use celeba64/"
+                "dcgan128/wgan-gp style presets)")
     size = cfg.model.output_size
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
