@@ -44,6 +44,54 @@ SCAN = max(1, min(int(os.environ.get("BENCH_SCAN", _SCAN_DEFAULT)),
                   STEPS_MEASURE))
 
 
+def _bench_sample(cfg, pt, state, n_chips: int) -> None:
+    """BENCH_MODE=sample: generation (inference) throughput through
+    ParallelTrain.sample — the serve analogue of the reference's only
+    generation path, the in-graph sampler (image_train.py:179-192).
+
+    One dispatch per call (there is no scanned multi-sample), so the z
+    batch is deliberately large (default 1024/chip) to amortize the
+    tunnel's ~7 ms per-dispatch RPC cost; z lives on device and is reused
+    across calls — throughput needs device work, not fresh latents.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    batch = int(os.environ.get("BENCH_SAMPLE_BATCH", 1024)) * n_chips
+    z = jax.random.uniform(jax.random.key(2), (batch, cfg.model.z_dim),
+                           minval=-1.0, maxval=1.0, dtype=jnp.float32)
+    labels = (jnp.asarray(
+        np.arange(batch) % cfg.model.num_classes),) \
+        if cfg.model.num_classes else ()
+    imgs = pt.sample(state, z, *labels)      # compile + warmup
+    float(imgs[0, 0, 0, 0])                  # value-readback sync (see main)
+
+    windows = int(os.environ.get("BENCH_WINDOWS", 3))
+    n_calls = max(1, STEPS_MEASURE // 20)
+    dt = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        for _ in range(n_calls):
+            imgs = pt.sample(state, z, *labels)
+        float(imgs[0, 0, 0, 0])
+        dt = min(dt, time.perf_counter() - t0)
+
+    img_per_sec_chip = batch * n_calls / dt / n_chips
+    arch = os.environ.get("BENCH_PRESET", "") or (
+        "SAGAN-64" if cfg.model.attn_res else "DCGAN-64")
+    print(json.dumps({
+        "metric": f"{arch} sampler (inference) throughput "
+                  f"(batch {batch // n_chips}/chip, bf16)",
+        "value": round(img_per_sec_chip, 1),
+        "unit": "images/sec/chip",
+        # vs the same adopted train baseline is meaningless for inference;
+        # report the ratio to our own measured train rate out-of-band (docs)
+        "vs_baseline": None,
+    }))
+    print(f"chips={n_chips} batch={batch} calls={n_calls} wall={dt:.2f}s "
+          f"ms_per_step={dt / n_calls * 1e3:.2f}", file=sys.stderr)
+
+
 def main() -> None:
     import jax
 
@@ -93,6 +141,9 @@ def main() -> None:
 
     size = cfg.model.output_size
     state = pt.init(jax.random.key(0))
+    if os.environ.get("BENCH_MODE") == "sample":
+        _bench_sample(cfg, pt, state, n_chips)
+        return
     images = jnp.asarray(np.random.default_rng(0).uniform(
         -1, 1, size=(cfg.batch_size, size, size, cfg.model.c_dim))
         .astype(np.float32))

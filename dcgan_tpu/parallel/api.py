@@ -131,6 +131,16 @@ def make_parallel_train(cfg: TrainConfig,
 
     constrain_micro = None
     if cfg.grad_accum > 1:
+        # same hard requirement the shard_map backend enforces: a microbatch
+        # that doesn't divide over the data axis would make GSPMD pad every
+        # microbatch to uneven shards — a silent throughput loss, not an
+        # error — so reject it here too
+        n_data = mesh.shape["data"]
+        if (cfg.batch_size // cfg.grad_accum) % n_data:
+            raise ValueError(
+                f"microbatch {cfg.batch_size // cfg.grad_accum} "
+                f"(batch_size/grad_accum) must divide over the {n_data}-way "
+                "data axis")
         # Pin the step's (grad_accum, micro, ...) input reshapes to
         # scan-axis-in-front shardings: left alone the partitioner may keep
         # the "data" sharding on the leading (scan) axis after the reshape,

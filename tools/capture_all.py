@@ -81,6 +81,8 @@ STEPS = [
     _bench("sagan64-attn-sn", BENCH_ATTN="1", BENCH_SN="1"),
     _bench("dcgan64-pallas", BENCH_PALLAS="1"),
     _bench("dcgan64-shard_map", BENCH_BACKEND="shard_map"),
+    _bench("dcgan64-sample", BENCH_MODE="sample"),
+    _bench("dcgan128-sample", BENCH_MODE="sample", BENCH_PRESET="dcgan128"),
     ("attention", "attn-crossover-small",
      [sys.executable, "tools/bench_attention.py",
       "--seq", "1024", "4096", "16384"], {}, 600, True),
@@ -199,19 +201,36 @@ def render_docs() -> None:
     rows = _load_captures()
 
     bench = _best_bench_rows(rows)
+    # inference (BENCH_MODE=sample) rows get their own table: their
+    # "ms" is per ~1024-image dispatch, not per 64-image train step —
+    # mixing the columns would misread as a 16x per-step slowdown
+    train = {k: v for k, v in bench.items()
+             if "sampler" not in v.get("metric", "")}
+    sample = {k: v for k, v in bench.items()
+              if "sampler" in v.get("metric", "")}
     lines = ["## Chip captures (tools/capture_all.py)", ""]
-    if bench:
+    if train:
         lines += ["Best successful capture per config (the tunnel's "
                   "throughput swings run-to-run; see README \"Benchmarks\" "
                   "for methodology):", "",
                   "| Config | images/sec/chip | ms/step | vs baseline | "
                   "captured |", "|---|---|---|---|---|"]
-        for label in sorted(bench):
-            b = bench[label]
+        for label in sorted(train):
+            b = train[label]
             ms = f"{b['ms']:.2f}" if b.get("ms") else "—"
             vs = f"{b['vs']:.2f}×" if b.get("vs") is not None else "—"
             lines.append(f"| {label} | {b['value']} | {ms} | {vs} | "
                          f"{b['date']} |")
+    if sample:
+        lines += ["", "Inference (sampler path, `BENCH_MODE=sample` — "
+                  "ms is per generation dispatch at the batch named in "
+                  "the metric, not per train step):", "",
+                  "| Config | images/sec/chip | ms/dispatch | captured |",
+                  "|---|---|---|---|"]
+        for label in sorted(sample):
+            b = sample[label]
+            ms = f"{b['ms']:.2f}" if b.get("ms") else "—"
+            lines.append(f"| {label} | {b['value']} | {ms} | {b['date']} |")
     else:
         lines += ["No successful chip captures yet (tunnel down every "
                   "attempt so far — every attempt is logged in "
@@ -228,6 +247,25 @@ def render_docs() -> None:
             if "source" in p:
                 lines.append(f"| {p['source']} | {p['value']} | "
                              f"{p.get('vs_synthetic', '—')} |")
+    fid_rows = [r for r in rows
+                if r["section"] == "fid" and r["rc"] == 0
+                and any("fid" in p for p in r.get("parsed", []))]
+    if fid_rows:
+        last = fid_rows[-1]  # latest complete trajectory (a matched set)
+        lines += ["", f"Chip FID/KID trajectory ({last['label']}, surrogate "
+                  f"features, {last['date']} — `{last['cmd']}`):", "",
+                  "| Step | surrogate FID | KID (×10³) |", "|---|---|---|"]
+        for p in last["parsed"]:
+            if "fid" in p:
+                kid = (f"{p['kid'] * 1e3:.3f}" if p.get("kid") is not None
+                       else "—")  # --kid is optional in fid_trajectory.py
+                lines.append(f"| {p['step']} | {p['fid']:.4f} | {kid} |")
+        summ = next((p for p in last["parsed"] if "monotonic" in p), None)
+        if summ:
+            lines += ["", f"monotonic={summ['monotonic']}, "
+                      f"Spearman(steps, FID)="
+                      f"{summ['spearman_steps_vs_fid']:.2f} over "
+                      f"{summ['snapshots']} snapshots."]
     loader = [(p, r["date"]) for r in rows
               if r["section"] == "loader" and r["rc"] == 0
               for p in r["parsed"] if "images_per_sec" in p]
@@ -252,8 +290,10 @@ def render_docs() -> None:
                 lines.append(f"| {form} | {seq} | {p['ms']:.2f} | ok | "
                              f"{p['date']} |")
             else:
-                lines.append(f"| {form} | {seq} | — | "
-                             f"{p.get('error', 'failed')} | {p['date']} |")
+                # table-safe error: first line only, ANSI stripped, bounded
+                err = re.sub(r"\x1b\[[0-9;]*m", "",
+                             p.get("error", "failed")).splitlines()[0][:90]
+                lines.append(f"| {form} | {seq} | — | {err} | {p['date']} |")
     else:
         lines += ["Chip pending — the tunnel has not answered during a "
                   "capture window yet. CPU-side scaling evidence is in the "
@@ -271,6 +311,9 @@ def main(argv=None) -> None:
                         "(headline matrix attention fid realdata loader)")
     p.add_argument("--skip", nargs="+", default=[],
                    help="skip these sections")
+    p.add_argument("--labels", nargs="+", default=None,
+                   help="run only these step labels (targeted re-captures; "
+                        "composes with --only/--skip)")
     p.add_argument("--probe_timeout", type=float, default=60.0)
     p.add_argument("--render-only", action="store_true")
     args = p.parse_args(argv)
@@ -291,6 +334,8 @@ def main(argv=None) -> None:
         if args.only and section not in args.only:
             continue
         if section in args.skip:
+            continue
+        if args.labels and label not in args.labels:
             continue
         if needs_tunnel:
             if tunnel_ok is None:
