@@ -225,8 +225,10 @@ class TrainConfig:
                                    # memory. BN statistics are per-microbatch
                                    # with state chained (standard large-batch
                                    # emulation semantics, not bitwise equal to
-                                   # one full-batch BN pass). Requires
-                                   # n_critic=1.
+                                   # one full-batch BN pass). With n_critic>1
+                                   # each scanned critic iteration applies one
+                                   # Adam update from its own K-microbatch
+                                   # accumulation.
     diffaug: str = ""              # differentiable augmentation policy for
                                    # every D input (DiffAugment,
                                    # arXiv:2006.10738): comma-joined subset
@@ -427,11 +429,6 @@ class TrainConfig:
                 f"batch_size ({self.batch_size}) must be a multiple of "
                 f"grad_accum ({self.grad_accum}) — microbatches are "
                 "batch_size/grad_accum")
-        if self.grad_accum > 1 and self.n_critic > 1:
-            raise ValueError(
-                "grad_accum > 1 composes with n_critic=1 only (the scanned "
-                "critic loop already bounds memory per critic iteration; "
-                "accumulating inside it is not implemented)")
 
 
 # --------------------------------------------------------------------------

@@ -188,6 +188,25 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
     def _pmean(x):
         return lax.pmean(x, axis_name) if axis_name is not None else x
 
+    def _critic_streams(iter_key, batch):
+        """Per-critic-iteration randomness: fresh z against the same real
+        batch, the gradient-penalty key, and the DiffAugment key. One
+        definition shared by the accum and non-accum critic loops so their
+        training semantics cannot silently desynchronize."""
+        zk, gpk = jax.random.split(iter_key)
+        aug_k = jax.random.fold_in(iter_key, 3) if aug_policy else None
+        z_i = jax.random.uniform(zk, (batch, mcfg.z_dim),
+                                 minval=-1.0, maxval=1.0, dtype=jnp.float32)
+        return z_i, gpk, aug_k
+
+    def _zero_metric():
+        # Under shard_map (axis_name set) the critic-scan metric carry must
+        # be data-axis-VARYING to match the loop body's per-device metric
+        # outputs — an unvarying f32 zero fails the scan's carry-type check
+        # at trace time.
+        z0 = jnp.zeros((), jnp.float32)
+        return lax.pcast(z0, axis_name, to="varying") if axis_name else z0
+
     def _loss_metrics(d_loss, d_real, d_fake, g_loss, gp) -> dict:
         # one assembly for train_step and eval_losses so the sample/* probe
         # can never silently diverge from the training metrics; the gp slot
@@ -300,6 +319,13 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         microbatches exactly as it chains through consecutive steps; the
         single pmean/all-reduce per net happens on the AVERAGED gradient,
         so the collective cost per optimizer update is unchanged.
+
+        With n_critic > 1 the accumulation nests inside the scanned critic
+        loop: each critic iteration draws its own fresh full z batch
+        (matching the non-accum loop's semantics), splits it into K
+        microbatches, and applies one Adam update from the accumulated
+        gradient — n_critic Adam applies per step, each from a K-microbatch
+        mean, at one microbatch's activation memory throughout.
         """
         K = cfg.grad_accum
         micro = images.shape[0] // K
@@ -311,12 +337,18 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         def _split(x):
             return _cm(x.reshape(K, micro, *x.shape[1:]))
 
-        xs = {"img": _split(images), "z": _split(z),
-              "gpk": jax.random.split(gp_key, K)}
-        if labels is not None:
-            xs["lbl"] = _split(labels)
-        if aug_key is not None:
-            xs["augk"] = jax.random.split(aug_key, K)
+        imgs_s = _split(images)
+        lbls_s = _split(labels) if labels is not None else None
+
+        def _micro_xs(z_full, gpk, augk):
+            """One optimizer update's worth of per-microbatch scan inputs."""
+            xs = {"img": imgs_s, "z": _split(z_full),
+                  "gpk": jax.random.split(gpk, K)}
+            if lbls_s is not None:
+                xs["lbl"] = lbls_s
+            if augk is not None:
+                xs["augk"] = jax.random.split(augk, K)
+            return xs
 
         def _zeros_f32(tree):
             # accumulate in f32 whatever the param dtype: K bf16 adds would
@@ -332,23 +364,49 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             return _pmean(jax.tree_util.tree_map(
                 lambda a, p: (a / K).astype(p.dtype), acc, like))
 
-        # --- D: one Adam apply from K accumulated microbatch grads ----------
-        def d_micro(carry, x):
-            g_acc, bn_d = carry
-            bn_in = {"gen": bn["gen"], "disc": bn_d}
-            (d_loss, (d_bn_i, d_real, d_fake, gp)), grads = \
-                jax.value_and_grad(d_loss_fn, has_aux=True)(
-                    params["disc"], params["gen"], bn_in, x["img"], x["z"],
-                    x["gpk"], x.get("lbl"), state["step"], False,
-                    x.get("augk"))
-            return (_acc(g_acc, grads), d_bn_i), (d_loss, d_real, d_fake, gp)
+        # --- D: each Adam apply from K accumulated microbatch grads ---------
+        def d_accum_update(d_params, d_opt_state, bn_d_start, xs):
+            """Scan K microbatches at fixed d_params, apply Adam once."""
+            def d_micro(carry, x):
+                g_acc, bn_d = carry
+                bn_in = {"gen": bn["gen"], "disc": bn_d}
+                (d_loss, (d_bn_i, d_real, d_fake, gp)), grads = \
+                    jax.value_and_grad(d_loss_fn, has_aux=True)(
+                        d_params, params["gen"], bn_in, x["img"], x["z"],
+                        x["gpk"], x.get("lbl"), state["step"], False,
+                        x.get("augk"))
+                return ((_acc(g_acc, grads), d_bn_i),
+                        (d_loss, d_real, d_fake, gp))
 
-        (d_gacc, d_bn), (d_losses, d_reals, d_fakes, gps) = lax.scan(
-            d_micro, (_zeros_f32(params["disc"]), bn["disc"]), xs)
-        d_grads = _avg(d_gacc, params["disc"])
-        d_updates, d_opt = opt_d.update(d_grads, state["opt"]["disc"],
-                                        params["disc"])
-        new_disc = optax.apply_updates(params["disc"], d_updates)
+            (g_acc, bn_d), ms = lax.scan(
+                d_micro, (_zeros_f32(d_params), bn_d_start), xs)
+            updates, d_opt_state = opt_d.update(
+                _avg(g_acc, d_params), d_opt_state, d_params)
+            return (optax.apply_updates(d_params, updates), d_opt_state,
+                    bn_d, tuple(m.mean() for m in ms))
+
+        if cfg.n_critic == 1:
+            new_disc, d_opt, d_bn, (d_loss, d_real, d_fake, gp) = \
+                d_accum_update(params["disc"], state["opt"]["disc"],
+                               bn["disc"], _micro_xs(z, gp_key, aug_key))
+        else:
+            # the non-accum critic loop's semantics (fresh full z per
+            # iteration against the same real batch), each iteration's
+            # update accumulated over K microbatches
+            def critic_iter(carry, iter_key):
+                d_params_c, d_opt_c, d_bn_c, _ = carry
+                z_i, gpk, aug_k = _critic_streams(iter_key, images.shape[0])
+                out = d_accum_update(d_params_c, d_opt_c, d_bn_c,
+                                     _micro_xs(z_i, gpk, aug_k))
+                return out, None
+
+            zero = _zero_metric()
+            (new_disc, d_opt, d_bn,
+             (d_loss, d_real, d_fake, gp)), _ = lax.scan(
+                critic_iter,
+                (params["disc"], state["opt"]["disc"], bn["disc"],
+                 (zero, zero, zero, zero)),
+                jax.random.split(gp_key, cfg.n_critic))
 
         if cfg.update_mode == "sequential":
             g_target_disc, disc_bn_for_g = new_disc, d_bn
@@ -356,6 +414,10 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             g_target_disc, disc_bn_for_g = params["disc"], bn["disc"]
 
         # --- G: same accumulation against the (possibly updated) D ----------
+        # the top-level z/aug streams, like the non-accum G step (with
+        # n_critic > 1 the critic iterations drew their own)
+        g_xs = _micro_xs(z, gp_key, aug_key)
+
         def g_micro(carry, x):
             g_acc, bn_g = carry
             bn_in = {"gen": bn_g, "disc": disc_bn_for_g}
@@ -366,7 +428,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             return (_acc(g_acc, grads), g_bn_i), g_loss
 
         (g_gacc, g_bn), g_losses = lax.scan(
-            g_micro, (_zeros_f32(params["gen"]), bn["gen"]), xs)
+            g_micro, (_zeros_f32(params["gen"]), bn["gen"]), g_xs)
         g_grads = _avg(g_gacc, params["gen"])
         g_updates, g_opt = opt_g.update(g_grads, state["opt"]["gen"],
                                         params["gen"])
@@ -379,11 +441,10 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             "step": state["step"] + 1,
         }
         new_state["ema_gen"] = _ema_update(state, new_gen)
-        # metrics: microbatch means — the accumulation analogue of the
-        # non-accum path's full-batch values
-        return new_state, _loss_metrics(d_losses.mean(), d_reals.mean(),
-                                        d_fakes.mean(), g_losses.mean(),
-                                        gps.mean())
+        # metrics: microbatch means (with n_critic > 1, the LAST critic
+        # iteration's — matching the non-accum loop's last-iter reporting)
+        return new_state, _loss_metrics(d_loss, d_real, d_fake,
+                                        g_losses.mean(), gp)
 
     def train_step(state: Pytree, images: jax.Array, key: jax.Array,
                    labels: Optional[jax.Array] = None
@@ -422,12 +483,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             # the loop is lax.scan so XLA compiles the critic body once.
             def critic_iter(carry, iter_key):
                 d_params_c, d_opt_c, d_bn_c, _ = carry
-                zk, gpk = jax.random.split(iter_key)
-                aug_k = jax.random.fold_in(iter_key, 3) if aug_policy \
-                    else None
-                z_i = jax.random.uniform(
-                    zk, (images.shape[0], mcfg.z_dim),
-                    minval=-1.0, maxval=1.0, dtype=jnp.float32)
+                z_i, gpk, aug_k = _critic_streams(iter_key, images.shape[0])
                 bn_in = {"gen": bn["gen"], "disc": d_bn_c}
                 (loss_i, (bn_i, real_i, fake_i, gp_i)), grads = \
                     jax.value_and_grad(d_loss_fn, has_aux=True)(
@@ -443,7 +499,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                          (loss_i, real_i, fake_i, gp_i)), None)
 
             iter_keys = jax.random.split(gp_key, cfg.n_critic)
-            zero = jnp.zeros((), jnp.float32)
+            zero = _zero_metric()
             (new_disc, d_opt, d_bn,
              (d_loss, d_real, d_fake, gp)), _ = lax.scan(
                 critic_iter,
