@@ -121,6 +121,7 @@ def main() -> None:
             get_preset(preset_name),
             batch_size=BATCH * n_chips,
             mesh=MeshConfig(),
+            grad_accum=int(os.environ.get("BENCH_ACCUM", 1)),
             backend=os.environ.get("BENCH_BACKEND", "gspmd"))
     else:
         cfg = TrainConfig(
@@ -135,6 +136,10 @@ def main() -> None:
                 else "none"),
             batch_size=BATCH * n_chips,
             mesh=MeshConfig(),
+            # BENCH_ACCUM=K: gradient-accumulation cost — same global batch,
+            # K scanned microbatches per optimizer update. Composes with the
+            # other BENCH_* model knobs rather than forking its own config.
+            grad_accum=int(os.environ.get("BENCH_ACCUM", 1)),
             backend=os.environ.get("BENCH_BACKEND", "gspmd"))
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
@@ -205,6 +210,8 @@ def main() -> None:
         arch = preset_name
     else:
         arch = "SAGAN-64" if cfg.model.attn_res else "DCGAN-64"
+        if cfg.grad_accum > 1:
+            arch += f" grad_accum={cfg.grad_accum}"
     print(json.dumps({
         "metric": f"{arch} train throughput (batch {BATCH}/chip, bf16)",
         "value": round(img_per_sec_chip, 1),

@@ -76,6 +76,17 @@ def list_shards(data_dir: str) -> List[str]:
     return paths
 
 
+def read_manifest(data_dir: str) -> dict:
+    """The dataset.json sidecar prepare.py writes, or {} when absent —
+    lets read-only consumers (evals, trajectory tools) adopt the recorded
+    wire format instead of requiring the user to re-specify it."""
+    path = os.path.join(data_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
 def check_manifest(data_dir: str, cfg: "DataConfig") -> None:
     """Validate DataConfig against the dataset.json manifest, if present.
 
@@ -83,11 +94,9 @@ def check_manifest(data_dir: str, cfg: "DataConfig") -> None:
     DataConfig otherwise fails deep in the loader ("example has N values,
     expected M") or, for byte-coincidental sizes, silently misreads pixels.
     """
-    path = os.path.join(data_dir, MANIFEST_NAME)
-    if not os.path.exists(path):
+    manifest = read_manifest(data_dir)
+    if not manifest:
         return
-    with open(path) as f:
-        manifest = json.load(f)
     checks = [
         ("image_size", cfg.image_size),
         ("channels", cfg.channels),
@@ -111,7 +120,9 @@ def check_manifest(data_dir: str, cfg: "DataConfig") -> None:
             "config requests labels but the dataset was prepared unlabeled")
     if problems:
         raise ValueError(
-            f"DataConfig disagrees with {path}:\n  " + "\n  ".join(problems))
+            f"DataConfig disagrees with "
+            f"{os.path.join(data_dir, MANIFEST_NAME)}:\n  "
+            + "\n  ".join(problems))
 
 
 def shard_for_process(paths: Sequence[str], process_index: int,

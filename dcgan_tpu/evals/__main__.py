@@ -151,11 +151,20 @@ def main(argv: Optional[List[str]] = None) -> None:
                                  pool=0)
     else:
         from dcgan_tpu.data import DataConfig, make_dataset
+        from dcgan_tpu.data.pipeline import read_manifest
 
+        # adopt the wire format the records were prepared with (scoring has
+        # no --record_dtype flag on purpose — the manifest is authoritative
+        # for a read-only consumer; uint8 datasets score without ceremony).
+        # Only keys the manifest carries are passed, so DataConfig stays the
+        # single source of the defaults for manifest-less datasets.
+        manifest = read_manifest(args.data_dir)
+        wire = {k: manifest[k] for k in ("record_dtype", "feature_name")
+                if k in manifest}
         dcfg = DataConfig(data_dir=args.data_dir,
                           image_size=mcfg.output_size, channels=mcfg.c_dim,
                           batch_size=args.batch_size, seed=args.seed,
-                          normalize=True)
+                          normalize=True, **wire)
         if args.multihost and jax.process_count() > 1:
             # ADVICE r2: shard_for_process falls back to "everyone reads
             # everything, seeds differ" when there are fewer shards than

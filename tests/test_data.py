@@ -402,3 +402,44 @@ class TestPipeline:
         assert imgs.shape == (4, 8, 8, 3)
         assert labels.shape == (4,) and labels.dtype == np.int32
         assert (labels < 5).all()
+
+
+class TestManifestAdoption:
+    """read_manifest (pipeline.py): read-only consumers (evals,
+    fid_trajectory) adopt the dataset's recorded wire format instead of
+    requiring a --record_dtype flag they don't have."""
+
+    def test_read_manifest_absent_then_present(self, tmp_path):
+        from dcgan_tpu.data.pipeline import read_manifest
+
+        assert read_manifest(str(tmp_path)) == {}
+        (tmp_path / "dataset.json").write_text(
+            '{"record_dtype": "uint8", "feature_name": "image_raw"}')
+        assert read_manifest(str(tmp_path))["record_dtype"] == "uint8"
+
+    def test_uint8_dataset_loads_via_manifest(self, tmp_path):
+        """The evals-side construction: DataConfig derived from dataset.json
+        must load a uint8-record dataset that the float64 default would
+        reject at the manifest check."""
+        import json
+
+        from dcgan_tpu.data.pipeline import read_manifest
+
+        d = str(tmp_path)
+        write_image_tfrecords(d, num_examples=8, image_size=8,
+                              record_dtype="uint8", num_shards=1)
+        (tmp_path / "dataset.json").write_text(json.dumps(
+            {"record_dtype": "uint8", "image_size": 8, "channels": 3,
+             "feature_name": "image_raw"}))
+        m = read_manifest(d)
+        cfg = DataConfig(data_dir=d, image_size=8, batch_size=4,
+                         min_after_dequeue=4,
+                         record_dtype=m.get("record_dtype", "float64"),
+                         feature_name=m.get("feature_name", "image_raw"))
+        batch = next(iter(make_dataset(cfg)))
+        assert batch.shape == (4, 8, 8, 3)
+        # float64 default would have been rejected by check_manifest
+        bad = DataConfig(data_dir=d, image_size=8, batch_size=4,
+                         min_after_dequeue=4)
+        with pytest.raises(ValueError, match="record_dtype"):
+            next(iter(make_dataset(bad)))

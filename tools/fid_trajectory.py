@@ -130,13 +130,18 @@ def main(argv=None) -> None:
                                      mcfg.c_dim, seed=args.seed + 1, pool=0)
         else:
             from dcgan_tpu.data import DataConfig, make_dataset
+            from dcgan_tpu.data.pipeline import read_manifest
             from dcgan_tpu.parallel import batch_sharding
 
+            manifest = read_manifest(args.data_dir)  # wire format is the
+            wire = {k: manifest[k]                   # dataset's to declare
+                    for k in ("record_dtype", "feature_name")
+                    if k in manifest}
             data = make_dataset(
                 DataConfig(data_dir=args.data_dir,
                            image_size=mcfg.output_size,
                            channels=mcfg.c_dim, batch_size=args.batch_size,
-                           seed=args.seed, normalize=True),
+                           seed=args.seed, normalize=True, **wire),
                 batch_sharding(mesh, 4))
 
         result = compute_fid(
