@@ -31,9 +31,16 @@ class ModelConfig:
                                    # stride-2 5x5 stacks) | "resnet" (the
                                    # WGAN-GP/SNGAN residual blocks,
                                    # models/resnet.py — BN-free critic,
-                                   # upsample-conv G). Both scale by
-                                   # base_size*2^k and compose with
-                                   # conditioning/cBN/attention/SN/pallas
+                                   # upsample-conv G) | "stylegan"
+                                   # (StyleGAN2-lite: mapping network +
+                                   # modulated convs + skip tRGB,
+                                   # models/stylegan.py, paired with the
+                                   # resnet critic). All scale by
+                                   # base_size*2^k; dcgan/resnet compose
+                                   # with conditioning/cBN/attention/SN/
+                                   # pallas, stylegan with conditioning and
+                                   # spectral_norm="d" (no BN to condition,
+                                   # no attention site wired)
     output_size: int = 64          # spatial size of generated images (H == W)
     gf_dim: int = 64               # generator base feature maps
     df_dim: int = 64               # discriminator base feature maps
@@ -85,9 +92,24 @@ class ModelConfig:
                                    # BN moments (ops/spectral.py)
 
     def __post_init__(self):
-        if self.arch not in ("dcgan", "resnet"):
+        if self.arch not in ("dcgan", "resnet", "stylegan"):
             raise ValueError(
-                f"arch must be 'dcgan' or 'resnet', got {self.arch!r}")
+                f"arch must be 'dcgan', 'resnet', or 'stylegan', got "
+                f"{self.arch!r}")
+        if self.arch == "stylegan":
+            if self.conditional_bn:
+                raise ValueError(
+                    "arch='stylegan' has no BatchNorm to condition "
+                    "(styles carry conditioning); drop conditional_bn")
+            if self.attn_res:
+                raise ValueError(
+                    "arch='stylegan' has no attention site wired; use "
+                    "arch='dcgan'/'resnet' for attn_res")
+            if self.spectral_norm == "gd":
+                raise ValueError(
+                    "arch='stylegan' supports spectral_norm='d' (critic "
+                    "only) — SN on a style-modulated generator is not "
+                    "wired")
         n = self.num_up_layers
         if n < 1 or self.base_size * (2 ** n) != self.output_size:
             raise ValueError(

@@ -118,6 +118,10 @@ def generator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
         from dcgan_tpu.models import resnet
 
         return resnet.generator_init(key, cfg)
+    if cfg.arch == "stylegan":
+        from dcgan_tpu.models import stylegan
+
+        return stylegan.generator_init(key, cfg)
     k = cfg.num_up_layers
     dtype = _dtype(cfg)
     keys = jax.random.split(key, 2 * k + 2)
@@ -182,6 +186,13 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
         from dcgan_tpu.models import resnet
 
         return resnet.generator_apply(
+            params, state, z, cfg=cfg, train=train, labels=labels,
+            axis_name=axis_name, attn_mesh=attn_mesh,
+            pallas_mesh=pallas_mesh, capture=capture)
+    if cfg.arch == "stylegan":
+        from dcgan_tpu.models import stylegan
+
+        return stylegan.generator_apply(
             params, state, z, cfg=cfg, train=train, labels=labels,
             axis_name=axis_name, attn_mesh=attn_mesh,
             pallas_mesh=pallas_mesh, capture=capture)
@@ -265,7 +276,9 @@ def discriminator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
     Stage 0 has no BN, matching the reference (distriubted_model.py:118; its
     `d_bn0` is created but never used — SURVEY.md §2.4 #7 — we don't create one).
     """
-    if cfg.arch == "resnet":
+    if cfg.arch in ("resnet", "stylegan"):
+        # the stylegan family pairs its G with the same norm-free residual
+        # critic (StyleGAN2's own D is a plain resnet; pair with --r1_gamma)
         from dcgan_tpu.models import resnet
 
         return resnet.discriminator_init(key, cfg)
@@ -312,7 +325,7 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
     `capture` (dict) receives post-activation tensors "h0".."h{k-1}" plus the
     final "logit" — see generator_apply.
     """
-    if cfg.arch == "resnet":
+    if cfg.arch in ("resnet", "stylegan"):
         from dcgan_tpu.models import resnet
 
         return resnet.discriminator_apply(

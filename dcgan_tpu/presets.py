@@ -135,6 +135,20 @@ def sngan_cifar10(**overrides) -> TrainConfig:
     return dataclasses.replace(cfg, **overrides)
 
 
+def stylegan64(**overrides) -> TrainConfig:
+    """StyleGAN2-lite at 64x64 (models/stylegan.py): mapping network +
+    modulated convs + skip tRGB, paired with the norm-free residual critic
+    and the paper's training regularizer — lazy R1 (gamma 10, every 16th
+    step) — plus generator-weight EMA. Knowing deviations from the paper
+    (documented in models/stylegan.py): no noise injection / style mixing /
+    path-length regularization, Adam(2e-4, β1 0.5, β2 0.999) instead of
+    (2.5e-3, 0, 0.99), tanh-range output. Beyond-reference model family."""
+    cfg = _build(ModelConfig(arch="stylegan", output_size=64),
+                 MeshConfig(), batch_size=64,
+                 r1_gamma=10.0, r1_interval=16, g_ema_decay=0.999)
+    return dataclasses.replace(cfg, **overrides)
+
+
 PRESETS: Dict[str, Callable[..., TrainConfig]] = {
     "celeba64": celeba64,
     "lsun64-dp8": lsun64_dp8,
@@ -144,6 +158,7 @@ PRESETS: Dict[str, Callable[..., TrainConfig]] = {
     "sagan64": sagan64,
     "sagan128": sagan128,
     "sngan-cifar10": sngan_cifar10,
+    "stylegan64": stylegan64,
 }
 
 

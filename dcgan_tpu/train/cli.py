@@ -64,9 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="one-sided label smoothing: D's real target becomes "
                         "1-eps (gan loss only)")
     # model (image_train.py:15-18 — wired here, unlike the reference)
-    p.add_argument("--arch", choices=["dcgan", "resnet"], default="dcgan",
-                   help="model family: the reference's DCGAN stacks or the "
-                        "WGAN-GP/SNGAN residual blocks")
+    p.add_argument("--arch", choices=["dcgan", "resnet", "stylegan"],
+                   default="dcgan",
+                   help="model family: the reference's DCGAN stacks, the "
+                        "WGAN-GP/SNGAN residual blocks, or StyleGAN2-lite "
+                        "(modulated convs + resnet critic; pair with "
+                        "--r1_gamma)")
     p.add_argument("--output_size", type=int, default=64)
     p.add_argument("--c_dim", type=int, default=3)
     p.add_argument("--z_dim", type=int, default=100)

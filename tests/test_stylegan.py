@@ -1,0 +1,198 @@
+"""StyleGAN2-lite family (models/stylegan.py, arch="stylegan"): mapping
+network + modulated convolutions + skip tRGB through the same entry
+points, machinery, and parallel layers as the other stacks; paired with
+the norm-free residual critic (models/resnet.py)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from dcgan_tpu.models.dcgan import (
+    discriminator_apply,
+    gan_init,
+    generator_apply,
+    sampler_apply,
+)
+
+TINY = ModelConfig(arch="stylegan", output_size=16, gf_dim=8, df_dim=8,
+                   compute_dtype="float32")
+
+
+def _z(n=4, dim=100, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).uniform(
+        -1, 1, (n, dim)), jnp.float32)
+
+
+def real_batch(n=16, size=16):
+    rng = np.random.default_rng(0)
+    return jnp.asarray(
+        np.tanh(rng.normal(size=(n, size, size, 3))).astype(np.float32))
+
+
+class TestShapes:
+    def test_generator_shapes_range_and_statelessness(self):
+        params, bn = gan_init(jax.random.key(0), TINY)
+        img, new_state = generator_apply(params["gen"], bn["gen"], _z(),
+                                         cfg=TINY, train=True)
+        assert img.shape == (4, 16, 16, 3)
+        assert img.dtype == jnp.float32
+        assert float(jnp.abs(img).max()) <= 1.0
+        # no BN anywhere: the generator state is empty, train has no effect
+        assert bn["gen"] == {} and new_state == {}
+        img_eval, _ = generator_apply(params["gen"], bn["gen"], _z(),
+                                      cfg=TINY, train=False)
+        np.testing.assert_array_equal(np.asarray(img), np.asarray(img_eval))
+
+    def test_discriminator_is_resnet_critic(self):
+        """arch='stylegan' pairs G with the norm-free residual critic —
+        same param names, no BN state."""
+        params, bn = gan_init(jax.random.key(0), TINY)
+        assert bn["disc"] == {}
+        assert "head" in params["disc"] and "b0_conv1" in params["disc"]
+        x = real_batch(4)
+        prob, logit, _ = discriminator_apply(params["disc"], bn["disc"], x,
+                                             cfg=TINY, train=True)
+        assert logit.shape == (4, 1) and logit.dtype == jnp.float32
+
+    def test_styles_modulate_output(self):
+        """Different z must produce different images THROUGH the styles:
+        the synthesis input is a constant, so z only enters via w."""
+        params, bn = gan_init(jax.random.key(0), TINY)
+        a, _ = generator_apply(params["gen"], bn["gen"], _z(seed=1),
+                               cfg=TINY, train=True)
+        b, _ = generator_apply(params["gen"], bn["gen"], _z(seed=2),
+                               cfg=TINY, train=True)
+        assert float(jnp.abs(a - b).max()) > 1e-3
+
+    def test_demodulation_normalizes_weight_scale(self):
+        """Demodulated convs are invariant to the conv-weight SCALE (the
+        property that stands in for equalized LR): scaling every b*_conv*
+        kernel leaves the pre-tRGB features unchanged."""
+        params, bn = gan_init(jax.random.key(0), TINY)
+        cap1, cap2 = {}, {}
+        generator_apply(params["gen"], bn["gen"], _z(), cfg=TINY,
+                        train=True, capture=cap1)
+        scaled = {k: ({**v, "w": v["w"] * 7.0}
+                      if k.endswith(("_conv1", "_conv2")) else v)
+                  if isinstance(v, dict) else v
+                  for k, v in params["gen"].items()}
+        generator_apply(scaled, bn["gen"], _z(), cfg=TINY, train=True,
+                        capture=cap2)
+        # h-features equal up to f32 noise (biases unscaled, demod exact)
+        for k in ("h1", "h2"):
+            np.testing.assert_allclose(np.asarray(cap1[k]),
+                                       np.asarray(cap2[k]),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_conditional_z_concat(self):
+        cfg = dataclasses.replace(TINY, num_classes=4)
+        params, bn = gan_init(jax.random.key(0), cfg)
+        labels = jnp.asarray([0, 1, 2, 3])
+        img, _ = generator_apply(params["gen"], bn["gen"], _z(), cfg=cfg,
+                                 train=True, labels=labels)
+        assert img.shape == (4, 16, 16, 3)
+        img2, _ = generator_apply(params["gen"], bn["gen"], _z(), cfg=cfg,
+                                  train=True, labels=jnp.asarray([1, 0, 3, 2]))
+        assert float(jnp.abs(img - img2).max()) > 1e-4
+        with pytest.raises(ValueError, match="labels"):
+            generator_apply(params["gen"], bn["gen"], _z(), cfg=cfg,
+                            train=True)
+
+    def test_capture_channels(self):
+        params, bn = gan_init(jax.random.key(0), TINY)
+        cap = {}
+        generator_apply(params["gen"], bn["gen"], _z(), cfg=TINY,
+                        train=True, capture=cap)
+        assert "w" in cap and "h1" in cap and "h3" in cap  # k=2 stages + out
+
+    def test_validation_rejects_unwired_composition(self):
+        with pytest.raises(ValueError, match="conditional"):
+            ModelConfig(arch="stylegan", output_size=16, num_classes=2,
+                        conditional_bn=True)
+        with pytest.raises(ValueError, match="attention"):
+            ModelConfig(arch="stylegan", output_size=16, attn_res=8)
+        with pytest.raises(ValueError, match="spectral_norm"):
+            ModelConfig(arch="stylegan", output_size=16, spectral_norm="gd")
+
+
+class TestTraining:
+    def test_train_step_sample_and_r1(self):
+        """The stylegan64 recipe at tiny scale: R1-regularized BCE with the
+        SN critic, EMA sampling — one jitted step, finite metrics, moving
+        params."""
+        from dcgan_tpu.train import make_train_step
+
+        cfg = TrainConfig(
+            model=dataclasses.replace(TINY, spectral_norm="d"),
+            batch_size=16, r1_gamma=10.0, g_ema_decay=0.99)
+        fns = make_train_step(cfg)
+        s = fns.init(jax.random.key(0))
+        step = jax.jit(fns.train_step)
+        for i in range(3):
+            s, m = step(s, real_batch(), jax.random.fold_in(
+                jax.random.key(1), i))
+        assert int(s["step"]) == 3
+        for k, v in m.items():
+            assert np.isfinite(float(v)), (k, v)
+        assert "r1" in m
+        img = fns.sample(s, _z(16))
+        assert img.shape == (16, 16, 16, 3)
+        assert float(jnp.abs(img).max()) <= 1.0
+
+    @pytest.mark.slow
+    def test_sharded_step_matches_single_device(self):
+        """Same equivalence contract as the other families: the dp8-sharded
+        stylegan step equals the single-device step (no BN means no
+        moment-sync subtlety — pure data-parallel grads)."""
+        from dcgan_tpu.parallel import make_parallel_train
+        from dcgan_tpu.train import make_train_step
+
+        cfg = TrainConfig(model=TINY, batch_size=16, mesh=MeshConfig())
+        xs, key = real_batch(), jax.random.key(3)
+        fns = make_train_step(cfg)
+        s_ref, m_ref = jax.jit(fns.train_step)(fns.init(jax.random.key(0)),
+                                               xs, key)
+        pt = make_parallel_train(cfg)
+        s_par, m_par = pt.step(pt.init(jax.random.key(0)), xs, key)
+        np.testing.assert_allclose(float(m_par["d_loss"]),
+                                   float(m_ref["d_loss"]), rtol=1e-5)
+        np.testing.assert_allclose(float(m_par["g_loss"]),
+                                   float(m_ref["g_loss"]), rtol=1e-5)
+        diff = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))),
+            s_ref["params"], jax.device_get(s_par["params"]))
+        assert max(jax.tree_util.tree_leaves(diff)) \
+            <= 2 * cfg.learning_rate + 1e-5
+
+    @pytest.mark.slow
+    def test_sampler_and_checkpoint_roundtrip(self, tmp_path):
+        """sampler_apply goes through the same dispatch; checkpoint the
+        state and restore it under a generate-style config."""
+        from dcgan_tpu.train import make_train_step
+        from dcgan_tpu.utils.checkpoint import Checkpointer
+
+        cfg = TrainConfig(model=TINY, batch_size=8,
+                          checkpoint_dir=str(tmp_path))
+        fns = make_train_step(cfg)
+        s = fns.init(jax.random.key(0))
+        s, _ = jax.jit(fns.train_step)(s, real_batch(8), jax.random.key(1))
+        ck = Checkpointer(str(tmp_path))
+        ck.save(1, s, force=True)
+        ck.wait()
+        restored = Checkpointer(str(tmp_path)).restore_latest(
+            jax.eval_shape(fns.init, jax.random.key(0)))
+        img = sampler_apply(restored["params"]["gen"], restored["bn"]["gen"],
+                            _z(8), cfg=TINY)
+        assert img.shape == (8, 16, 16, 3)
+
+    def test_preset_exists(self):
+        from dcgan_tpu.presets import get_preset
+
+        cfg = get_preset("stylegan64")
+        assert cfg.model.arch == "stylegan"
+        assert cfg.r1_gamma > 0 and cfg.r1_interval == 16
+        assert cfg.g_ema_decay == 0.999

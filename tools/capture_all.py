@@ -85,6 +85,7 @@ STEPS = [
     _bench("dcgan128-sample", BENCH_MODE="sample", BENCH_PRESET="dcgan128"),
     _bench("dcgan64-b256", BENCH_BATCH="256"),
     _bench("dcgan64-accum4", BENCH_ACCUM="4"),
+    _bench("stylegan64", BENCH_PRESET="stylegan64"),
     ("attention", "attn-crossover-small",
      [sys.executable, "tools/bench_attention.py",
       "--seq", "1024", "4096", "16384"], {}, 600, True),
