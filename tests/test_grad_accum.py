@@ -60,6 +60,7 @@ def test_accum_step_runs_and_updates():
         atol=1e-6)
 
 
+@pytest.mark.slow
 def test_accum_close_to_full_batch_step():
     """Same batch, same key: K=2 vs K=1 may differ only through
     per-microbatch BN moments — losses must land in the same neighborhood
@@ -76,11 +77,11 @@ def test_accum_close_to_full_batch_step():
             k, float(m1[k]), float(m2[k]))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "mesh_cfg",
     [pytest.param(MeshConfig(), id="dp8"),
-     pytest.param(MeshConfig(model=2), id="dp4xtp2",
-                  marks=pytest.mark.slow)])
+     pytest.param(MeshConfig(model=2), id="dp4xtp2")])
 def test_sharded_accum_matches_single_device(mesh_cfg):
     """The sharded accumulation program must equal the unsharded one on the
     same global batch — the same equivalence contract as
@@ -122,6 +123,7 @@ def test_shard_map_accum_runs():
         assert np.isfinite(float(v)), (k, v)
 
 
+@pytest.mark.slow
 def test_accum_with_n_critic():
     """n_critic > 1 x grad_accum > 1: each scanned critic iteration applies
     one Adam update from its own K-microbatch accumulation (the WGAN-GP

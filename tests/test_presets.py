@@ -14,7 +14,7 @@ class TestPresets:
         # (presets.py docstrings).
         assert set(PRESETS) == {
             "celeba64", "lsun64-dp8", "dcgan128", "cifar10-cond", "wgan-gp",
-            "sagan64", "sagan128", "sngan-cifar10"}
+            "sagan64", "sagan128", "sngan-cifar10", "stylegan64"}
 
     def test_celeba64_is_reference_headline(self):
         cfg = get_preset("celeba64")

@@ -120,6 +120,7 @@ class TestShapes:
 
 
 class TestTraining:
+    @pytest.mark.slow
     def test_train_step_sample_and_r1(self):
         """The stylegan64 recipe at tiny scale: R1-regularized BCE with the
         SN critic, EMA sampling — one jitted step, finite metrics, moving
