@@ -67,7 +67,9 @@ def _bench_sample(cfg, pt, state, n_chips: int) -> None:
     float(imgs[0, 0, 0, 0])                  # value-readback sync (see main)
 
     windows = int(os.environ.get("BENCH_WINDOWS", 3))
-    n_calls = max(1, STEPS_MEASURE // 20)
+    # own knob: sample dispatch count must not silently track the
+    # train-step BENCH_STEPS knob (the two measure different programs)
+    n_calls = int(os.environ.get("BENCH_SAMPLE_CALLS", 20))
     dt = float("inf")
     for _ in range(windows):
         t0 = time.perf_counter()

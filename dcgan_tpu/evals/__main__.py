@@ -30,7 +30,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=256)
     # architecture flags default to None = "take it from the checkpoint's
     # config.json" (written by the trainer); explicit flags override
-    p.add_argument("--arch", choices=["dcgan", "resnet", "stylegan"], default=None,
+    p.add_argument("--arch", choices=["dcgan", "resnet", "stylegan"],
+                   default=None,
                    help="match the checkpoint's model family")
     p.add_argument("--output_size", type=int, default=None)
     p.add_argument("--c_dim", type=int, default=None)

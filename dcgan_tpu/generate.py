@@ -45,7 +45,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="named config (presets.py) supplying the model "
                         "architecture instead of the checkpoint's "
                         "config.json; explicit flags override")
-    p.add_argument("--arch", choices=["dcgan", "resnet", "stylegan"], default=None,
+    p.add_argument("--arch", choices=["dcgan", "resnet", "stylegan"],
+                   default=None,
                    help="match the checkpoint's model family")
     p.add_argument("--output_size", type=int, default=None)
     p.add_argument("--c_dim", type=int, default=None)

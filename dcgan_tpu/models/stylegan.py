@@ -71,7 +71,10 @@ def generator_init(key, cfg: ModelConfig) -> Tuple[Pytree, Pytree]:
     k = cfg.num_up_layers
     dtype = jnp.dtype(cfg.param_dtype)
     chans = _g_channels(cfg)
-    keys = jax.random.split(key, 6 * k + 4)
+    # key budget: 3 head keys (map0/map1/const) + 6 per block, consumed as
+    # keys[6*i - 3 : 6*i + 3] for i in 1..k — max index 6k+2, so exactly
+    # 6k+3 keys
+    keys = jax.random.split(key, 6 * k + 3)
 
     in_dim = cfg.z_dim + (cfg.num_classes if cfg.num_classes else 0)
     params: Pytree = {

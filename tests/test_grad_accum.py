@@ -78,6 +78,38 @@ def test_accum_close_to_full_batch_step():
 
 
 @pytest.mark.slow
+def test_accum_exact_without_bn():
+    """With a BN-free family (stylegan: empty state tree, nothing couples
+    samples) K=2 must reproduce K=1 EXACTLY — mean of per-microbatch mean
+    gradients equals the full-batch mean, so the whole post-step state
+    matches to float32 accumulation-order tolerance.
+
+    Comparing ONLY params would be toothless here: Adam's update is
+    scale-invariant (m̂/√v̂), so a sum-vs-mean bug (grads K× too big) moves
+    one step's params only at eps scale. It is the OPTIMIZER MOMENTS that
+    scream — m off by K, v by K² — so the assertion walks params AND both
+    Adam chains (ADVICE r3 #1: the BN sanity band above cannot pin this)."""
+    tiny_sg = ModelConfig(arch="stylegan", output_size=16, gf_dim=8,
+                          df_dim=8, compute_dtype="float32")
+    xs, key = real_batch(), jax.random.key(3)
+    base = TrainConfig(model=tiny_sg, batch_size=16)
+    f1 = make_train_step(base)
+    s1, _ = jax.jit(f1.train_step)(f1.init(jax.random.key(0)), xs, key)
+    f2 = make_train_step(dataclasses.replace(base, grad_accum=2))
+    s2, _ = jax.jit(f2.train_step)(f2.init(jax.random.key(0)), xs, key)
+    for part in ("params", "opt", "ema_gen"):
+        flat1 = jax.tree_util.tree_leaves_with_path(s1[part])
+        flat2 = jax.tree_util.tree_leaves(s2[part])
+        assert len(flat1) == len(flat2)
+        for (path, a), b in zip(flat1, flat2):
+            np.testing.assert_allclose(
+                np.asarray(a, dtype=np.float64),
+                np.asarray(b, dtype=np.float64),
+                rtol=1e-3, atol=5e-8,
+                err_msg=f"{part}{jax.tree_util.keystr(path)}")
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize(
     "mesh_cfg",
     [pytest.param(MeshConfig(), id="dp8"),

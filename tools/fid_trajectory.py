@@ -56,7 +56,8 @@ def main(argv=None) -> None:
                    help="named config (presets.py); default = tiny/flagship")
     p.add_argument("--tiny", action="store_true",
                    help="16x16 gf=df=8 f32 model — the CPU validity config")
-    p.add_argument("--arch", choices=["dcgan", "resnet", "stylegan"], default="dcgan",
+    p.add_argument("--arch", choices=["dcgan", "resnet", "stylegan"],
+                   default="dcgan",
                    help="model family for the --tiny/default configs")
     p.add_argument("--snapshots", default="0,50,100,200,400",
                    help="comma-joined step counts to score (ascending)")
