@@ -61,9 +61,12 @@ class ModelConfig:
     kernel_size: int = 5           # conv / deconv kernel (distriubted_model.py:176,190)
     compute_dtype: str = "bfloat16"  # MXU-native compute precision
     param_dtype: str = "float32"     # parameter / BN-stat storage precision
-    use_pallas: bool = False       # fused Pallas BN+activation kernels
-                                   # (ops/pallas_kernels.py; single-chip /
-                                   # per-shard execution)
+    use_pallas: bool = False       # fused Pallas BN+act kernels (+ flash
+                                   # attention when attn_res > 0). Capability
+                                   # flag, NOT a perf flag: measured SLOWER
+                                   # at flagship shapes (-23% in-step; XLA's
+                                   # fusion already sits at the HBM roof —
+                                   # DESIGN.md §8b)
     attn_res: int = 0              # >0 inserts a SAGAN-style self-attention
                                    # block (ops/attention.py) into both stacks
                                    # at the stage whose feature maps are
@@ -282,6 +285,13 @@ class TrainConfig:
     label_feature: str = "label"   # int64 per-example class feature, read when
                                    # model.num_classes > 0 (the schema the
                                    # reference comments out, image_input.py:44)
+    synthetic_device_cache: int = 0  # >0 (synthetic data only): pre-stage
+                                   # this many sharded batches ON DEVICE and
+                                   # cycle them — removes host->device feed
+                                   # from the loop so the trainer's own hot-
+                                   # loop machinery can be measured at chip
+                                   # rate over transports that cannot sustain
+                                   # the feed (tools/bench_trainer_loop.py)
 
     # Observability (image_train.py:37,129,179)
     checkpoint_dir: str = "checkpoint"

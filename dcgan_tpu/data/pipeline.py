@@ -342,6 +342,20 @@ def make_dataset(cfg: DataConfig, sharding=None,
     import jax
 
     check_manifest(cfg.data_dir, cfg)
+    if cfg.record_dtype == "float64" and any(
+            d.platform not in ("cpu",) for d in jax.devices()):
+        # The parity wire format is input-bound at accelerator rates by this
+        # repo's own measurements (BASELINE.md: ~14-18k img/s one-core
+        # float64 decode ceiling vs ~21.5k img/s chip consumption). Warn,
+        # don't fail: short runs and parity experiments are legitimate.
+        import warnings
+
+        warnings.warn(
+            "float64 TFRecords feeding an accelerator: the float64 decode "
+            "ceiling (~14-18k img/s/core) is below the chip's measured "
+            "consumption rate — re-prepare with --record_dtype uint8 "
+            "(the default) unless byte-exact reference parity is the goal",
+            RuntimeWarning, stacklevel=2)
     paths = shard_for_process(list_shards(cfg.data_dir),
                               jax.process_index(), jax.process_count())
     loader = _make_loader(cfg, paths, cfg.seed + jax.process_index())

@@ -9,6 +9,14 @@ missing producer, implemented as the reference *intended*: center-crop to
 `crop_size`, resize to `image_size`, serialize in the exact schema the input
 pipeline (and its C++ loader) consumes.
 
+Default wire format is uint8, not the reference's float64: this repo's own
+measurements (BASELINE.md) put the one-core float64 decode ceiling at
+~14-18k img/s against a ~21.5k img/s chip consumption rate — the parity
+format is input-bound at chip rates by construction (8 bytes/pixel for
+values that carry 8 bits). `--record_dtype float64` keeps the strict-parity
+byte format available, and the pipeline warns when it meets a chip-rate
+consumer (data/pipeline.py).
+
     python -m dcgan_tpu.data.prepare --input_dir photos/ --output_dir train/
     python -m dcgan_tpu.data.prepare --input_dir cifar/ --output_dir recs/ \
         --labeled --image_size 32 --crop_size 0   # labels from subdir names
@@ -129,7 +137,7 @@ def _write_shards(output_dir: str, items: list, record_fn,
 
 def convert(input_dir: str, output_dir: str, *, image_size: int = 64,
             crop_size: int = 108, channels: int = 3, num_shards: int = 8,
-            record_dtype: str = "float64", labeled: bool = False,
+            record_dtype: str = "uint8", labeled: bool = False,
             feature_name: str = "image_raw",
             label_feature: str = "label", seed: int = 0,
             overwrite: bool = False) -> List[str]:
@@ -256,9 +264,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num_shards", type=int, default=8)
     p.add_argument("--record_dtype", default=None,
                    choices=["float64", "float32", "uint8"],
-                   help="on-disk pixel dtype; default float64 (matches the "
-                        "reference, image_input.py:48) or uint8 with "
-                        "--cifar10; uint8 is 8x smaller")
+                   help="on-disk pixel dtype; default uint8 (8x smaller, "
+                        "and the only wire format whose one-core decode "
+                        "ceiling clears the chip's measured consumption "
+                        "rate — BASELINE.md); pass float64 for strict "
+                        "parity with the reference (image_input.py:48)")
     p.add_argument("--labeled", action="store_true",
                    help="class subdirectories -> int64 label feature")
     p.add_argument("--cifar10", action="store_true",
@@ -287,7 +297,7 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                         image_size=args.image_size or 64,
                         crop_size=args.crop_size,
                         channels=args.channels, num_shards=args.num_shards,
-                        record_dtype=args.record_dtype or "float64",
+                        record_dtype=args.record_dtype or "uint8",
                         labeled=args.labeled,
                         seed=args.seed, overwrite=args.overwrite)
     print(f"wrote {len(paths)} shards to {args.output_dir}")

@@ -108,10 +108,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="feed raw pixel scale (strict reference parity, "
                         "SURVEY.md 2.4 #1)")
     p.add_argument("--record_dtype", default="float64",
-                   choices=["float64", "float32", "uint8"])
+                   choices=["float64", "float32", "uint8"],
+                   help="wire format for manifest-less corpora; a "
+                        "dataset.json manifest's record_dtype is "
+                        "authoritative (adopted, like evals)")
     p.add_argument("--label_feature", default="label",
                    help="int64 class feature name in the records "
                         "(used when --num_classes > 0)")
+    p.add_argument("--synthetic_device_cache", type=int, default=0,
+                   help="with --synthetic: pre-stage N batches on device "
+                        "and cycle them (loop-speed measurement; see "
+                        "tools/bench_trainer_loop.py)")
     # observability / checkpoint (image_train.py:20-21,37,129)
     p.add_argument("--checkpoint_dir", default="checkpoint")
     p.add_argument("--sample_dir", default="samples")
@@ -131,6 +138,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fid_num_samples", type=int, default=2048,
                    help="samples per side for the in-training FID probe "
                         "(must divide evenly over the process count)")
+    p.add_argument("--nan_check_steps", type=int, default=100,
+                   help="all-process numerical-health gate cadence (0 = "
+                        "off); each check reads metric values, which costs "
+                        "a device round-trip")
     p.add_argument("--log_every_steps", type=int, default=1,
                    help="stdout loss-line cadence (1 = the reference's "
                         "every-step log; 0 = off)")
@@ -203,6 +214,7 @@ _FLAG_FIELDS = {
     "sample_image_dir": ("", "sample_image_dir"),
     "record_dtype": ("", "record_dtype"),
     "label_feature": ("", "label_feature"),
+    "synthetic_device_cache": ("", "synthetic_device_cache"),
     "checkpoint_dir": ("", "checkpoint_dir"), "sample_dir": ("", "sample_dir"),
     "save_summaries_secs": ("", "save_summaries_secs"),
     "save_model_secs": ("", "save_model_secs"),
@@ -211,6 +223,7 @@ _FLAG_FIELDS = {
     "fid_every_steps": ("", "fid_every_steps"),
     "fid_num_samples": ("", "fid_num_samples"),
     "log_every_steps": ("", "log_every_steps"),
+    "nan_check_steps": ("", "nan_check_steps"),
     "activation_summary_steps": ("", "activation_summary_steps"),
     "profile_dir": ("", "profile_dir"),
     "profile_start_step": ("", "profile_start_step"),

@@ -59,11 +59,14 @@ class TestConvert:
         assert len(paths) == 3
         manifest = json.load(open(os.path.join(dst, "dataset.json")))
         assert manifest["num_examples"] == 12
-        assert manifest["record_dtype"] == "float64"
+        # default wire format is uint8 (VERDICT r3 #6: the float64 parity
+        # format is input-bound at chip rates; it stays available behind
+        # record_dtype="float64" — exercised by the roundtrip test below)
+        assert manifest["record_dtype"] == "uint8"
 
         cfg = DataConfig(data_dir=dst, image_size=16, batch_size=4,
                          min_after_dequeue=4, n_threads=2, seed=0,
-                         normalize=True, loop=False)
+                         normalize=True, loop=False, record_dtype="uint8")
         batch = next(iter(make_dataset(cfg)))
         assert batch.shape == (4, 16, 16, 3)
         # 128/127.5 - 1 ~ 0.0039 after [-1,1] normalization
@@ -92,7 +95,8 @@ class TestConvert:
         assert manifest["classes"] == ["cat", "dog"]
         cfg = DataConfig(data_dir=dst, image_size=8, batch_size=6,
                          min_after_dequeue=2, n_threads=1, seed=0,
-                         normalize=False, loop=False, label_feature="label")
+                         normalize=False, loop=False, label_feature="label",
+                         record_dtype="uint8")
         imgs, labels = next(iter(make_dataset(cfg)))
         labels = np.asarray(labels)
         imgs = np.asarray(imgs)

@@ -241,6 +241,28 @@ class TestTrainLoop:
         state = train(cfg, max_steps=3)
         assert int(jax.device_get(state["step"])) == 3
 
+    def test_manifest_record_dtype_adopted(self, tmp_path):
+        """prepare now defaults to uint8 records while the trainer's
+        record_dtype default stays float64 (reference parity) — the
+        manifest's wire format must be adopted, same policy as evals, or
+        the default prepare-then-train path fails its own manifest check."""
+        import json
+
+        from dcgan_tpu.data.synthetic import write_image_tfrecords
+        write_image_tfrecords(str(tmp_path / "data"), num_examples=64,
+                              image_size=16, num_shards=2,
+                              record_dtype="uint8")
+        # prepare.py writes the manifest; the synthetic test writer doesn't
+        with open(tmp_path / "data" / "dataset.json", "w") as f:
+            json.dump({"record_dtype": "uint8", "num_examples": 64,
+                       "image_size": 16}, f)
+        cfg = tiny_cfg(tmp_path, data_dir=str(tmp_path / "data"),
+                       shuffle_buffer=16, num_loader_threads=2,
+                       sample_every_steps=0)
+        assert cfg.record_dtype == "float64"  # the mismatch being adopted
+        state = train(cfg, max_steps=2)
+        assert int(jax.device_get(state["step"])) == 2
+
     def test_conditional_real_labeled_tfrecords(self, tmp_path):
         """Conditional slice over labeled shards: int64 `label` feature ->
         native loader -> sharded (images, labels) -> conditional train step."""
