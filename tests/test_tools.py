@@ -1,0 +1,135 @@
+"""The measurement tools behind DESIGN.md §1b and the captures table.
+
+What must hold: the spread/aggregation math the docs tables are rendered
+from (tools/capture_all.py), the trainer-log parsing bench_trainer_loop's
+throughput derivation rests on, and a CPU execution of the matmul-rate and
+step-profile tools end to end (tiny shapes — the contract is "runs and
+prints well-formed JSON", the numbers only mean anything on a chip).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.capture_all import _best_bench_rows, _render_roofline, _spread  # noqa: E402
+
+
+class TestSpread:
+    def test_odd_even_and_single(self):
+        assert _spread([3.0]) == {"n": 1, "median": 3.0, "min": 3.0,
+                                  "max": 3.0}
+        assert _spread([1.0, 9.0]) == {"n": 2, "median": 5.0, "min": 1.0,
+                                       "max": 9.0}
+        s = _spread([5.0, 1.0, 3.0])
+        assert s["median"] == 3.0 and s["min"] == 1.0 and s["max"] == 5.0
+
+    def test_best_rows_carry_spread(self):
+        rows = [
+            {"section": "matrix", "label": "a", "rc": 0, "date": "d1",
+             "ms_per_step": 3.0,
+             "parsed": [{"value": 10.0, "unit": "u", "vs_baseline": 5.0,
+                         "metric": "m"}]},
+            {"section": "matrix", "label": "a", "rc": 0, "date": "d2",
+             "ms_per_step": 2.0,
+             "parsed": [{"value": 20.0, "unit": "u", "vs_baseline": 10.0,
+                         "metric": "m"}]},
+            # failures and other sections must not count
+            {"section": "matrix", "label": "a", "rc": 1, "date": "d3",
+             "parsed": [{"value": 99.0}]},
+            {"section": "fid", "label": "a", "rc": 0, "date": "d4",
+             "parsed": [{"value": 77.0}]},
+        ]
+        best = _best_bench_rows(rows)
+        a = best["a"]
+        # best row's metadata comes from the winning capture
+        assert a["value"] == 20.0 and a["ms"] == 2.0 and a["date"] == "d2"
+        assert a["n"] == 2 and a["min"] == 10.0 and a["max"] == 20.0
+        assert a["median"] == 15.0
+
+    def test_roofline_render(self):
+        rows = [
+            {"section": "roofline", "label": "matmul-rate", "rc": 0,
+             "date": "d1", "parsed": [
+                 {"form": "matmul", "m": 8, "n": 8, "tflops": 1.0,
+                  "ms_per_matmul": 0.5},
+                 {"form": "matmul", "m": 8, "n": 8, "tflops": 2.0,
+                  "ms_per_matmul": 0.25}]},  # best per shape wins
+            {"section": "roofline", "label": "step-profile", "rc": 0,
+             "date": "d1", "parsed": [
+                 {"label": "step-profile", "batch": 64, "scan": 50,
+                  "step_ms": 3.0, "fwd_ms": 2.0, "bwd_opt_ms_derived": 1.0,
+                  "g_forward_ms": 1.5, "adam_ms": 1.2,
+                  "flops_per_step": 192e9, "bytes_accessed": 2.3e9,
+                  "tflops_effective": 64.0, "hbm_gbps_effective": 766.0}]},
+            {"section": "roofline", "label": "trainer-loop", "rc": 0,
+             "date": "d1", "parsed": [
+                 {"label": "trainer-loop", "images_per_sec_chip": 19000.0,
+                  "ms_per_step": 3.3, "steps_per_call": 50}]},
+            # a failed roofline row contributes nothing
+            {"section": "roofline", "label": "trainer-loop", "rc": 1,
+             "date": "d2", "parsed": [
+                 {"label": "trainer-loop", "images_per_sec_chip": 9e9}]},
+        ]
+        text = "\n".join(_render_roofline(rows))
+        assert "| 8×8×8 | 2.0 | 0.25 |" in text   # best-per-shape
+        assert "192.0 GFLOP" in text
+        assert "19000 img/s/chip" in text
+        assert "9000000000" not in text
+
+    def test_roofline_render_empty(self):
+        assert _render_roofline([]) == []
+
+
+class TestTrainerLoopParsing:
+    def test_log_regex_and_window(self):
+        from tools.bench_trainer_loop import LOG_RE
+
+        out = ("[dcgan_tpu] epoch 0 step 500 time 30.0s d_loss 1.0 "
+               "g_loss 1.0\n"
+               "[dcgan_tpu] epoch 0 step 1000 time 33.2s d_loss 1.0 "
+               "g_loss 1.0\n"
+               "[dcgan_tpu] epoch 1 step 5000 time 46.0s d_loss 1.0 "
+               "g_loss 1.0\n")
+        pts = [(int(m.group(1)), float(m.group(2)))
+               for m in LOG_RE.finditer(out)]
+        assert pts == [(500, 30.0), (1000, 33.2), (5000, 46.0)]
+
+
+@pytest.mark.slow
+class TestToolsRunOnCpu:
+    def test_matmul_rate_cpu(self):
+        env = dict(os.environ, BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu",
+                   MATMUL_SHAPES="64x64,64x128", MATMUL_ITERS="2",
+                   MATMUL_WINDOWS="1")
+        res = subprocess.run(
+            [sys.executable, "tools/matmul_rate.py"], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=300)
+        assert res.returncode == 0, res.stderr[-500:]
+        lines = [json.loads(l) for l in res.stdout.splitlines()
+                 if l.startswith("{")]
+        shapes = [(p["m"], p["n"]) for p in lines if p.get("form")]
+        assert shapes == [(64, 64), (64, 128)]
+        summ = lines[-1]
+        assert summ["label"] == "matmul-rate" and summ["peak_tflops"] > 0
+
+    def test_step_profile_cpu(self):
+        env = dict(os.environ, BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu",
+                   BENCH_BATCH="8", BENCH_SCAN="2", BENCH_WINDOWS="1")
+        res = subprocess.run(
+            [sys.executable, "tools/step_profile.py"], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=600)
+        assert res.returncode == 0, res.stderr[-500:]
+        lines = [json.loads(l) for l in res.stdout.splitlines()
+                 if l.startswith("{")]
+        comps = {p["component"] for p in lines if "component" in p}
+        assert comps == {"train_step", "fwd_losses", "g_forward",
+                         "adam_applies"}
+        summ = lines[-1]
+        assert summ["label"] == "step-profile"
+        assert summ["step_ms"] > 0 and summ["fwd_ms"] > 0

