@@ -56,9 +56,10 @@ class TestSpread:
         rows = [
             {"section": "roofline", "label": "matmul-rate", "rc": 0,
              "date": "d1", "parsed": [
+                 # pre-K capture (square chain): treated as K = N
                  {"form": "matmul", "m": 8, "n": 8, "tflops": 1.0,
                   "ms_per_matmul": 0.5},
-                 {"form": "matmul", "m": 8, "n": 8, "tflops": 2.0,
+                 {"form": "matmul", "m": 8, "k": 8, "n": 8, "tflops": 2.0,
                   "ms_per_matmul": 0.25}]},  # best per shape wins
             {"section": "roofline", "label": "step-profile", "rc": 0,
              "date": "d1", "parsed": [
@@ -120,7 +121,11 @@ class TestToolsRunOnCpu:
 
     def test_step_profile_cpu(self):
         env = dict(os.environ, BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu",
-                   BENCH_BATCH="8", BENCH_SCAN="2", BENCH_WINDOWS="1")
+                   BENCH_BATCH="8", BENCH_SCAN="2", BENCH_WINDOWS="1",
+                   # keep CALLS (= BENCH_STEPS//BENCH_SCAN) at 2 — the
+                   # sync-amortization default of 400 steps/window is a
+                   # chip policy, ~200x the acceptable CPU smoke work
+                   BENCH_STEPS="4")
         res = subprocess.run(
             [sys.executable, "tools/step_profile.py"], cwd=REPO, env=env,
             capture_output=True, text=True, timeout=600)

@@ -6,20 +6,24 @@ VERDICT r3 #1 (weak #2): that denominator existed only as narrative. This
 tool IS the measurement — runnable standalone or under tools/capture_all.py
 (section "roofline"), so the number regenerates with every harvest.
 
-Method: y <- y @ W iterated K times inside one compiled lax.fori_loop, y
-[M, N] and W [N, N] both bf16, W scaled by 1/sqrt(N) so magnitudes stay
-O(1) across iterations (bf16 never overflows; no renormalization work
-pollutes the loop). The dependency chain serializes iterations on purpose —
-each matmul is large enough to fill the MXU on its own, and chaining keeps
-the loop compute-bound in registers/VMEM rather than HBM-streaming fresh
-operands (we are measuring the MXU ceiling, not HBM bandwidth). Sync is by
-value readback, not block_until_ready, for the same reason bench.py's is
-(the tunneled transport can report completion early). Best of
-MATMUL_WINDOWS windows, like every other capture in this repo.
+Method: the alternating pair y <- (y @ W1) @ W2 iterated ITERS times
+inside one compiled lax.fori_loop — y [M, K], W1 [K, N], W2 [N, K], all
+bf16, weights scaled by 1/sqrt(fan-in) so magnitudes stay O(1) across
+iterations (bf16 never overflows; no renormalization work pollutes the
+loop). Two matmuls per iteration let non-square (M, K, N) shapes chain,
+which is how the sweep covers the model's own conv contractions, not just
+square ceilings. The dependency chain serializes on purpose — each matmul
+must stand on its own, and chaining keeps the loop compute-bound in
+registers/VMEM rather than HBM-streaming fresh operands (we are measuring
+the MXU ceiling, not HBM bandwidth). Sync is by value readback, not
+block_until_ready, for the same reason bench.py's is (the tunneled
+transport can report completion early). Best of MATMUL_WINDOWS windows,
+like every other capture in this repo.
 
 Prints one JSON line per shape and a final summary line:
-  {"form": "matmul", "m": M, "n": N, "tflops": T, "ms_per_matmul": t}
-  {"label": "matmul-rate", "peak_tflops": T, "peak_shape": "MxNxN", ...}
+  {"form": "matmul", "m": M, "k": K, "n": N, "tflops": T,
+   "ms_per_matmul": t}
+  {"label": "matmul-rate", "peak_tflops": T, "peak_shape": "MxKxN", ...}
 
 The per-shape sweep is the defense of the number: if the sustained rate is
 far below nameplate, the sweep shows whether bigger shapes close the gap
@@ -39,49 +43,68 @@ import time
 
 import numpy as np
 
-# (M, N) pairs: y [M, N] @ W [N, N]. The sweep brackets the headline
-# model's real contraction sizes (conv-as-matmul K in the hundreds-to-few-
-# thousand range) and the asymptotic MXU-filling regime (4k-8k).
-# MATMUL_SHAPES="m1xn1,m2xn2" overrides (CPU smoke tests use tiny shapes).
-_DEFAULT_SHAPES = [(1024, 1024), (2048, 2048), (4096, 4096), (8192, 8192),
-                   (4096, 8192)]
-SHAPES = ([tuple(int(v) for v in s.split("x"))
-           for s in os.environ["MATMUL_SHAPES"].split(",")]
+# (M, K, N) triples: alternating y[M,K] @ W1[K,N] @ W2[N,K] chain (two
+# matmuls per iteration, so non-square shapes chain too). The sweep covers
+# the asymptotic MXU-filling regime (square 1k-8k — the ceiling claim) AND
+# the headline model's own conv contractions as implicit im2col GEMMs
+# (M = batch*out_h*out_w, K = kh*kw*cin, N = cout for the four
+# discriminator stages, distriubted_model.py:114-121) — the per-layer
+# ceilings the step's effective rate is bounded by.
+# MATMUL_SHAPES="MxN,MxKxN,..." overrides (MxN means square: K=N=that).
+_DEFAULT_SHAPES = [
+    (1024, 1024, 1024), (2048, 2048, 2048), (4096, 4096, 4096),
+    (8192, 8192, 8192), (4096, 8192, 8192),
+    # DCGAN-64 discriminator stages at batch 64 (G's deconvs transpose them)
+    (65536, 75, 64), (16384, 1600, 128), (4096, 3200, 256),
+    (1024, 6400, 512),
+]
+
+
+def _parse_shape(s: str):
+    v = [int(x) for x in s.split("x")]
+    return (v[0], v[1], v[1]) if len(v) == 2 else tuple(v[:3])
+
+
+SHAPES = ([_parse_shape(s) for s in os.environ["MATMUL_SHAPES"].split(",")]
           if os.environ.get("MATMUL_SHAPES") else _DEFAULT_SHAPES)
-ITERS = int(os.environ.get("MATMUL_ITERS", 200))      # matmuls per dispatch
+ITERS = int(os.environ.get("MATMUL_ITERS", 200))      # iterations per
+# dispatch; each iteration is TWO matmuls (the alternating pair)
 WINDOWS = int(os.environ.get("MATMUL_WINDOWS", 3))
 
 
-def _bench_shape(m: int, n: int) -> dict:
+def _bench_shape(m: int, k: int, n: int) -> dict:
     import jax
     import jax.numpy as jnp
 
     rng = np.random.default_rng(0)
-    y0 = jnp.asarray(rng.standard_normal((m, n)), dtype=jnp.bfloat16)
-    w = jnp.asarray(rng.standard_normal((n, n)) / np.sqrt(n),
-                    dtype=jnp.bfloat16)
+    y0 = jnp.asarray(rng.standard_normal((m, k)), dtype=jnp.bfloat16)
+    w1 = jnp.asarray(rng.standard_normal((k, n)) / np.sqrt(k),
+                     dtype=jnp.bfloat16)
+    w2 = jnp.asarray(rng.standard_normal((n, k)) / np.sqrt(n),
+                     dtype=jnp.bfloat16)
 
     @jax.jit
-    def chain(y, w):
+    def chain(y, w1, w2):
         def body(_, y):
-            return jnp.dot(y, w)
+            return jnp.dot(jnp.dot(y, w1), w2)
         return jax.lax.fori_loop(0, ITERS, body, y)
 
-    y = chain(y0, w)            # compile + warmup
+    y = chain(y0, w1, w2)       # compile + warmup
     float(y[0, 0])              # value-readback sync
     dt = float("inf")
     for _ in range(WINDOWS):
         t0 = time.perf_counter()
-        y = chain(y0, w)
+        y = chain(y0, w1, w2)
         float(y[0, 0])
         dt = min(dt, time.perf_counter() - t0)
 
-    flops = 2.0 * m * n * n * ITERS
-    return {"form": "matmul", "m": m, "n": n,
+    n_matmuls = 2 * ITERS       # the alternating pair per iteration
+    flops = 4.0 * m * k * n * ITERS
+    return {"form": "matmul", "m": m, "k": k, "n": n,
             # full precision for peak selection; rounded for display
             "tflops_raw": flops / dt / 1e12,
             "tflops": round(flops / dt / 1e12, 4),
-            "ms_per_matmul": round(dt / ITERS * 1e3, 4)}
+            "ms_per_matmul": round(dt / n_matmuls * 1e3, 4)}
 
 
 def main() -> None:
@@ -96,8 +119,8 @@ def main() -> None:
 
     dev = acquire_devices()[0]
     peak = None
-    for m, n in SHAPES:
-        row = _bench_shape(m, n)
+    for m, k, n in SHAPES:
+        row = _bench_shape(m, k, n)
         raw = row.pop("tflops_raw")
         print(json.dumps(row), flush=True)
         if peak is None or raw > peak[0]:
@@ -106,7 +129,7 @@ def main() -> None:
     print(json.dumps({
         "label": "matmul-rate",
         "peak_tflops": peak["tflops"],
-        "peak_shape": f"{peak['m']}x{peak['n']}x{peak['n']}",
+        "peak_shape": f"{peak['m']}x{peak['k']}x{peak['n']}",
         "iters_per_dispatch": ITERS,
         "device": str(dev),
     }), flush=True)
