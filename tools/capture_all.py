@@ -84,6 +84,12 @@ STEPS = [
     _bench("dcgan64-sample", BENCH_MODE="sample"),
     _bench("dcgan128-sample", BENCH_MODE="sample", BENCH_PRESET="dcgan128"),
     _bench("dcgan64-b256", BENCH_BATCH="256"),
+    # batch-scaling series: the step is HBM-bandwidth-bound at batch 64
+    # (DESIGN.md §1b), so img/s should keep rising with batch as weights
+    # and optimizer traffic amortize — these rows are that curve
+    _bench("dcgan64-b128", BENCH_BATCH="128"),
+    _bench("dcgan64-b512", BENCH_BATCH="512"),
+    _bench("dcgan64-b1024", BENCH_BATCH="1024"),
     _bench("dcgan64-accum4", BENCH_ACCUM="4"),
     _bench("stylegan64", BENCH_PRESET="stylegan64"),
     ("attention", "attn-crossover-small",
@@ -222,7 +228,8 @@ def _render_roofline(rows):
             continue
         for p in r.get("parsed", []):
             if p.get("form") == "matmul":
-                key = (p["m"], p["n"])
+                # older captures predate the K dim (square chains: K = N)
+                key = (p["m"], p.get("k", p["n"]), p["n"])
                 if key not in shapes or p["tflops"] > shapes[key]["tflops"]:
                     shapes[key] = dict(p, date=r["date"])
             elif p.get("label") == "step-profile":
@@ -235,11 +242,11 @@ def _render_roofline(rows):
         out += ["Roofline: sustained bf16 matmul rate (tools/"
                 "matmul_rate.py, best per shape) — the "
                 "MFU denominator, regenerated with every harvest:", "",
-                "| shape (M×N×N) | TFLOP/s | ms/matmul | captured |",
+                "| shape (M×K×N) | TFLOP/s | ms/matmul | captured |",
                 "|---|---|---|---|"]
-        for (m, n) in sorted(shapes):
-            p = shapes[(m, n)]
-            out.append(f"| {m}×{n}×{n} | {p['tflops']} | "
+        for (m, k, n) in sorted(shapes):
+            p = shapes[(m, k, n)]
+            out.append(f"| {m}×{k}×{n} | {p['tflops']} | "
                        f"{p['ms_per_matmul']} | {p['date']} |")
     if profiles:
         best = min(profiles, key=lambda p: p["step_ms"])
