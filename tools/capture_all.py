@@ -104,6 +104,8 @@ STEPS = [
      {}, 600, True),
     ("roofline", "trainer-loop",
      [sys.executable, "tools/bench_trainer_loop.py"], {}, 900, True),
+    ("roofline", "pallas-op",
+     [sys.executable, "tools/bench_pallas_op.py"], {}, 600, True),
     ("fid", "fid-trajectory-chip",
      [sys.executable, "tools/fid_trajectory.py", "--preset", "cifar10-cond",
       "--snapshots", "0,500,2000,5000", "--num_samples", "10000", "--kid"],
@@ -223,6 +225,8 @@ def _render_roofline(rows):
     shapes = {}      # (m, n) -> best tflops row (+date)
     profiles = []
     trainer = []
+    bn_ops = {}      # shape -> LATEST jnp-vs-pallas row (a ratio has no
+    #                  meaningful best-of; rows in one run share a window)
     for r in rows:
         if r["section"] != "roofline" or r["rc"] != 0:
             continue
@@ -232,6 +236,8 @@ def _render_roofline(rows):
                 key = (p["m"], p.get("k", p["n"]), p["n"])
                 if key not in shapes or p["tflops"] > shapes[key]["tflops"]:
                     shapes[key] = dict(p, date=r["date"])
+            elif p.get("form") == "bn_op":
+                bn_ops[tuple(p["shape"])] = dict(p, date=r["date"])
             elif p.get("label") == "step-profile":
                 profiles.append(dict(p, date=r["date"]))
             elif p.get("label") == "trainer-loop" and \
@@ -270,6 +276,19 @@ def _render_roofline(rows):
                     f"{best.get('hbm_gbps_effective', 0):.0f} GB/s at the "
                     "best-window step time. See DESIGN.md \"Roofline\" for "
                     "the reading."]
+    if bn_ops:
+        date = max(p["date"] for p in bn_ops.values())
+        out += ["", f"Op-level fused-BN+act, Pallas vs XLA (tools/"
+                f"bench_pallas_op.py, fwd+bwd, latest run {date}) — the "
+                "measurement behind use_pallas being a capability flag, "
+                "not a perf flag (DESIGN.md §8b):", "",
+                "| activation shape | XLA ms | Pallas ms | XLA/Pallas |",
+                "|---|---|---|---|"]
+        for shape in sorted(bn_ops):
+            p = bn_ops[shape]
+            out.append(f"| {list(shape)} | {p['jnp_ms']} | "
+                       f"{p['pallas_ms']} | "
+                       f"{p['ratio_jnp_over_pallas']}× |")
     if trainer:
         best = max(trainer, key=lambda p: p["images_per_sec_chip"])
         sp = _spread([p["images_per_sec_chip"] for p in trainer])
