@@ -86,6 +86,52 @@ class TestSpread:
     def test_roofline_render_empty(self):
         assert _render_roofline([]) == []
 
+    def test_render_docs_end_to_end(self, tmp_path, monkeypatch):
+        """render_docs over a synthetic captures log into temp docs: every
+        fid-trajectory label renders its own table (a latest-run-wins
+        render would let one ladder evict the other), and loader spreads
+        group per wire format (pooling float64 and uint8 into one min-max
+        would fabricate a range no format has)."""
+        import tools.capture_all as ca
+
+        rows = [
+            {"section": "fid", "label": "long", "rc": 0, "date": "d1",
+             "cmd": "c1", "parsed": [{"step": 0, "fid": 0.5},
+                                     {"monotonic": True,
+                                      "spearman_steps_vs_fid": -1.0,
+                                      "snapshots": 1}]},
+            {"section": "fid", "label": "early", "rc": 0, "date": "d2",
+             "cmd": "c2", "parsed": [{"step": 0, "fid": 0.4}]},
+            {"section": "fid", "label": "long", "rc": 0, "date": "d3",
+             "cmd": "c3", "parsed": [{"step": 0, "fid": 0.3}]},
+            {"section": "loader", "label": "loader-ceiling", "rc": 0,
+             "date": "d1", "cmd": "c", "parsed": [
+                 {"images_per_sec": 15000.0, "record_dtype": "float64",
+                  "threads": 16}]},
+            {"section": "loader", "label": "loader-ceiling-uint8", "rc": 0,
+             "date": "d1", "cmd": "c", "parsed": [
+                 {"images_per_sec": 27000.0, "record_dtype": "uint8",
+                  "threads": 16}]},
+        ]
+        captures = tmp_path / "captures.jsonl"
+        captures.write_text("".join(json.dumps(r) + "\n" for r in rows))
+        baseline = tmp_path / "B.md"
+        design = tmp_path / "D.md"
+        baseline.write_text("# B\n")
+        design.write_text("# D\n")
+        monkeypatch.setattr(ca, "CAPTURES", str(captures))
+        monkeypatch.setattr(ca, "BASELINE_MD", str(baseline))
+        monkeypatch.setattr(ca, "DESIGN_MD", str(design))
+        ca.render_docs()
+        text = baseline.read_text()
+        assert "Chip FID/KID trajectory (long" in text
+        assert "Chip FID/KID trajectory (early" in text
+        assert "`c3`" in text and "`c1`" not in text  # latest long run wins
+        assert "- float64: best 15000 img/s" in text
+        assert "- uint8: best 27000 img/s" in text
+        # spreads are per-format: no pooled 15000-27000 range anywhere
+        assert "15000–27000" not in text
+
 
 class TestTrainerLoopParsing:
     def test_log_regex_and_window(self):

@@ -110,9 +110,21 @@ STEPS = [
      [sys.executable, "tools/fid_trajectory.py", "--preset", "cifar10-cond",
       "--snapshots", "0,500,2000,5000", "--num_samples", "10000", "--kid"],
      {}, 1800, True),
+    # dense early-phase ladder for the same conditional preset: the long
+    # trajectory's tail oscillates (GAN non-monotonicity — why best-FID
+    # retention exists); the improvement-dominated early phase is where
+    # the ranking signal must show, and this row measures it at scale
+    ("fid", "fid-trajectory-cond-early",
+     [sys.executable, "tools/fid_trajectory.py", "--preset", "cifar10-cond",
+      "--snapshots", "0,100,250,500,1000", "--num_samples", "10000",
+      "--kid"], {}, 1500, True),
     ("realdata", "realdata-celeba64",
      [sys.executable, "tools/bench_realdata.py"], {}, 1200, True),
     ("loader", "loader-ceiling", [sys.executable, "tools/bench_loader.py"],
+     {}, 900, False),
+    # the default wire format's ceiling (uint8 since r4 — prepare.py)
+    ("loader", "loader-ceiling-uint8",
+     [sys.executable, "tools/bench_loader.py", "--record_dtype", "uint8"],
      {}, 900, False),
     # CPU-bound (no tunnel), last: ~20 min of host time. Regenerates the
     # cross-seed rank-stability evidence (BASELINE.md table).
@@ -388,8 +400,13 @@ def render_docs() -> None:
     fid_rows = [r for r in rows
                 if r["section"] == "fid" and r["rc"] == 0
                 and any("fid" in p for p in r.get("parsed", []))]
-    if fid_rows:
-        last = fid_rows[-1]  # latest complete trajectory (a matched set)
+    # latest complete trajectory PER LABEL (each label is its own ladder —
+    # e.g. the long oscillating-tail run vs the dense early-phase run)
+    latest_by_label = {}
+    for r in fid_rows:
+        latest_by_label[r["label"]] = r
+    for label in sorted(latest_by_label):
+        last = latest_by_label[label]
         lines += ["", f"Chip FID/KID trajectory ({last['label']}, surrogate "
                   f"features, {last['date']} — `{last['cmd']}`):", "",
                   "| Step | surrogate FID | KID (×10³) |", "|---|---|---|"]
@@ -408,18 +425,22 @@ def render_docs() -> None:
               if r["section"] == "loader" and r["rc"] == 0
               for p in r["parsed"] if "images_per_sec" in p]
     if loader:
-        # best capture, like the bench rows — but with the spread shown:
-        # the 1-core host swings ~2x run-to-run (and harvests often share
-        # the core with other work), which the best alone would hide
-        peak, date = max(loader, key=lambda v: v[0]["images_per_sec"])
-        sp = _spread([p["images_per_sec"] for p, _ in loader])
-        lines += ["", f"Loader re-check (CPU-bound, one host core): best "
-                  f"{peak['images_per_sec']:.0f} img/s "
-                  f"({peak.get('threads', '?')} threads, "
-                  f"{peak.get('record_dtype', '?')}, {date}); "
-                  f"median {sp['median']:.0f}, range "
-                  f"{sp['min']:.0f}–{sp['max']:.0f} over n={sp['n']} "
-                  "captures."]
+        # best capture per wire format, like the bench rows — with the
+        # spread shown: the 1-core host swings ~2x run-to-run (and
+        # harvests often share the core), which the best alone would hide
+        lines += ["", "Loader re-check (CPU-bound, one host core), per "
+                  "wire format:"]
+        dtypes = sorted({p.get("record_dtype", "?") for p, _ in loader})
+        for dt in dtypes:
+            rows_dt = [(p, d) for p, d in loader
+                       if p.get("record_dtype", "?") == dt]
+            peak, date = max(rows_dt, key=lambda v: v[0]["images_per_sec"])
+            sp = _spread([p["images_per_sec"] for p, _ in rows_dt])
+            lines += [f"- {dt}: best {peak['images_per_sec']:.0f} img/s "
+                      f"({peak.get('threads', '?')} threads, {date}); "
+                      f"median {sp['median']:.0f}, range "
+                      f"{sp['min']:.0f}–{sp['max']:.0f} over n={sp['n']} "
+                      "captures."]
 
     # roofline section (VERDICT r3 #1/#4): sustained matmul rate, step
     # cost/profile, and the real trainer loop measured as one group
