@@ -29,7 +29,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_job(tmp_path, backend: str, *, fid: bool = False) -> None:
+def _run_job(tmp_path, backend: str, *, fid: bool = False,
+             steps_per_call: int = 1) -> None:
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -42,6 +43,7 @@ def _run_job(tmp_path, backend: str, *, fid: bool = False) -> None:
             "MH_DIR": str(tmp_path),
             "MH_BACKEND": backend,
             "MH_FID": "1" if fid else "0",
+            "MH_SPC": str(steps_per_call),
             "PYTHONPATH": _REPO,
         })
         procs.append(subprocess.Popen(
@@ -72,6 +74,15 @@ def _run_job(tmp_path, backend: str, *, fid: bool = False) -> None:
 
 def test_two_process_gspmd(tmp_path):
     _run_job(tmp_path, "gspmd")
+
+
+def test_two_process_scanned_dispatch(tmp_path):
+    """steps_per_call=2 under a real 2-process job: the scanned multi_step
+    program compiles and executes over the cross-process mesh, the
+    pre-staged synthetic device pool feeds it through
+    make_array_from_process_local_data on every process, and the
+    K-aligned cadences (log/sample) fire on schedule."""
+    _run_job(tmp_path, "gspmd", steps_per_call=2)
 
 
 def test_two_process_fid_probe_and_best_retention(tmp_path):

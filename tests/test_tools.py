@@ -133,6 +133,31 @@ class TestSpread:
         assert "15000–27000" not in text
 
 
+class TestTraceSummary:
+    def test_committed_chip_trace_parses(self):
+        """The committed v5e trace artifact must keep yielding the step-time
+        evidence DESIGN.md §1b cites: 5 per-step train_step executions at
+        ~2.845 ms on the device's own timeline."""
+        from tools.trace_summary import find_trace, summarize
+
+        rows = summarize(find_trace(os.path.join(
+            REPO, "docs", "assets", "trace_train_step_v5e.json.gz")))
+        step = next(r for r in rows if "train_step" in r["program"])
+        assert step["n"] == 5
+        assert 2.8 < step["ms_min"] <= step["ms_max"] < 2.9
+
+    def test_find_trace_dir_and_missing(self, tmp_path):
+        from tools.trace_summary import find_trace
+
+        with pytest.raises(FileNotFoundError):
+            find_trace(str(tmp_path))
+        d = tmp_path / "plugins" / "profile" / "x"
+        d.mkdir(parents=True)
+        p = d / "vm.trace.json.gz"
+        p.write_bytes(b"")
+        assert find_trace(str(tmp_path)) == str(p)
+
+
 class TestTrainerLoopParsing:
     def test_log_regex_and_window(self):
         from tools.bench_trainer_loop import LOG_RE
