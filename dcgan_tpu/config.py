@@ -64,7 +64,7 @@ class ModelConfig:
     use_pallas: bool = False       # fused Pallas BN+act kernels (+ flash
                                    # attention when attn_res > 0). Capability
                                    # flag, NOT a perf flag: measured SLOWER
-                                   # at flagship shapes (-23% in-step; XLA's
+                                   # at flagship shapes (~20% in-step; XLA's
                                    # fusion already sits at the HBM roof —
                                    # DESIGN.md §8b)
     attn_res: int = 0              # >0 inserts a SAGAN-style self-attention
