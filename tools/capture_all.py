@@ -98,6 +98,10 @@ STEPS = [
     ("attention", "attn-crossover-wall",
      [sys.executable, "tools/bench_attention.py",
       "--seq", "32768", "40960", "45056", "49152", "65536"], {}, 900, True),
+    ("attention", "attn-memory",
+     [sys.executable, "tools/attention_memory.py",
+      "--seq", "8192", "16384", "32768", "40960", "45056", "49152"],
+     {}, 900, True),
     ("roofline", "matmul-rate", [sys.executable, "tools/matmul_rate.py"],
      {}, 600, True),
     ("roofline", "step-profile", [sys.executable, "tools/step_profile.py"],
@@ -220,15 +224,21 @@ def _best_bench_rows(rows):
 
 def _attention_rows(rows):
     """Latest result per (form, seq): ms or the error row (an allocation
-    failure IS the measurement — the dense wall)."""
+    failure IS the measurement — the dense wall). Returns (timing, memory)
+    maps; memory rows come from tools/attention_memory.py (temp_mib)."""
     out = {}
+    mem = {}
     for r in rows:
         if r["section"] != "attention":
             continue
         for p in r.get("parsed", []):
-            if "form" in p and "seq" in p:
+            if "form" not in p or "seq" not in p:
+                continue
+            if r["label"] == "attn-memory":
+                mem[(p["form"], p["seq"])] = dict(p, date=r["date"])
+            else:
                 out[(p["form"], p["seq"])] = dict(p, date=r["date"])
-    return out
+    return out, mem
 
 
 def _render_roofline(rows):
@@ -449,7 +459,7 @@ def render_docs() -> None:
         lines += [""] + roof_lines
     _render_block(BASELINE_MD, lines)
 
-    attn = _attention_rows(rows)
+    attn, attn_mem = _attention_rows(rows)
     lines = ["### Measured attention crossovers (chip)", ""]
     if attn:
         lines += ["| Form | S | ms (fwd+bwd) | status | captured |",
@@ -469,6 +479,23 @@ def render_docs() -> None:
                   "capture window yet. CPU-side scaling evidence is in the "
                   "table above; `python tools/capture_all.py` harvests this "
                   "table on the next live burst."]
+    if attn_mem:
+        lines += ["", "Scratch-HBM requirement per compiled fwd+bwd "
+                  "program (`compiled.memory_analysis()`, "
+                  "tools/attention_memory.py — exact program requirements, "
+                  "no execution involved; a compile failure at a size whose "
+                  "dense requirement exceeds HBM IS the memory wall):", "",
+                  "| Form | S | temp HBM (MiB) | captured |",
+                  "|---|---|---|---|"]
+        for (form, seq) in sorted(attn_mem, key=lambda k: (k[1], k[0])):
+            p = attn_mem[(form, seq)]
+            if p.get("temp_mib") is not None:
+                lines.append(f"| {form} | {seq} | {p['temp_mib']} | "
+                             f"{p['date']} |")
+            else:
+                err = re.sub(r"\x1b\[[0-9;]*m", "",
+                             p.get("error", "failed")).splitlines()[0][:70]
+                lines.append(f"| {form} | {seq} | — ({err}) | {p['date']} |")
     _render_block(DESIGN_MD, lines)
     print(f"[capture_all] rendered {len(bench)} bench row(s), "
           f"{len(attn)} attention row(s)", file=sys.stderr)
