@@ -528,11 +528,45 @@ def load_config(directory: str) -> Optional[TrainConfig]:
         return config_from_dict(json.load(f))
 
 
-# The ModelConfig knobs checkpoint consumers (generate/evals CLIs) expose as
-# override flags — one list so the two parsers cannot drift apart.
+# The ModelConfig knobs checkpoint consumers (generate/evals/export CLIs)
+# expose as override flags — one list so the parsers cannot drift apart.
 MODEL_OVERRIDE_FLAGS = ("arch", "output_size", "c_dim", "z_dim", "gf_dim",
                         "df_dim", "num_classes", "conditional_bn",
                         "attn_res", "attn_heads", "spectral_norm")
+
+
+def add_model_override_flags(p) -> None:
+    """Install the MODEL_OVERRIDE_FLAGS architecture flags on an argparse
+    parser — the one shared definition for every checkpoint-consumer CLI
+    (generate/evals/export; the trainer's parser wires these knobs with
+    live defaults instead of the None='not passed' convention used here).
+    Defaults are None so "explicitly passed" is distinguishable from
+    "omitted"; precedence is explicit flag > --preset > checkpoint
+    config.json > ModelConfig defaults (resolve_model_config).
+    """
+    import argparse
+
+    p.add_argument("--arch", choices=["dcgan", "resnet", "stylegan"],
+                   default=None,
+                   help="match the checkpoint's model family")
+    p.add_argument("--output_size", type=int, default=None)
+    p.add_argument("--c_dim", type=int, default=None)
+    p.add_argument("--z_dim", type=int, default=None)
+    p.add_argument("--gf_dim", type=int, default=None)
+    p.add_argument("--df_dim", type=int, default=None)
+    p.add_argument("--num_classes", type=int, default=None)
+    p.add_argument("--attn_res", type=int, default=None,
+                   help="match the checkpoint's attention config "
+                        "(presets supply it; explicit flag overrides)")
+    p.add_argument("--attn_heads", type=int, default=None,
+                   help="match the checkpoint's attention head count")
+    p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
+                   default=None,
+                   help="match the checkpoint's spectral-norm config")
+    p.add_argument("--conditional_bn", action=argparse.BooleanOptionalAction,
+                   default=None,
+                   help="match the checkpoint's conditional-BN config "
+                        "([K, C] per-class BN tables in G)")
 
 
 def resolve_model_config(checkpoint_dir: str, *, preset: Optional[str] = None,

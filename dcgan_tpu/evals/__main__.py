@@ -17,6 +17,8 @@ import argparse
 import json
 from typing import List, Optional
 
+from dcgan_tpu.config import add_model_override_flags
+
 
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dcgan_tpu.evals",
@@ -30,28 +32,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=256)
     # architecture flags default to None = "take it from the checkpoint's
     # config.json" (written by the trainer); explicit flags override
-    p.add_argument("--arch", choices=["dcgan", "resnet", "stylegan"],
-                   default=None,
-                   help="match the checkpoint's model family")
-    p.add_argument("--output_size", type=int, default=None)
-    p.add_argument("--c_dim", type=int, default=None)
-    p.add_argument("--z_dim", type=int, default=None)
-    p.add_argument("--gf_dim", type=int, default=None)
-    p.add_argument("--df_dim", type=int, default=None)
-    p.add_argument("--num_classes", type=int, default=None)
-    p.add_argument("--attn_res", type=int, default=None,
-                   help="match the checkpoint's attention config")
-    p.add_argument("--attn_heads", type=int, default=None,
-                   help="match the checkpoint's attention head count (an "
-                        "apply-time split — a mismatch loads cleanly but "
-                        "evaluates a different network)")
-    p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
-                   default=None,
-                   help="match the checkpoint's spectral-norm config")
-    p.add_argument("--conditional_bn", action=argparse.BooleanOptionalAction,
-                   default=None,
-                   help="match the checkpoint's conditional-BN config "
-                        "([K, C] per-class BN tables in G)")
+    add_model_override_flags(p)
     p.add_argument("--kid", action="store_true",
                    help="also report KID (subset-averaged unbiased MMD^2) "
                         "from the same feature pass")

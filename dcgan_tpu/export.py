@@ -1,0 +1,176 @@
+"""Serialized serving artifact: checkpoint -> portable StableHLO sampler.
+
+The reference's ONLY generation surface is the `sampler` node inside its
+train graph (SURVEY.md §3.4; image_train.py:179-192) — there is no way to
+ship a trained generator anywhere the training process isn't. This module is
+the deployment path the reference was missing: `jax.export` bakes the
+trained generator weights into ONE serialized StableHLO artifact that
+
+- is platform-retargetable (lowered for cpu AND tpu by default — the same
+  bytes serve on a TPU pod or a CPU box),
+- has a symbolic batch dimension (any batch size at call time, no retrace),
+- needs NOTHING from this framework to serve: any process with jax installed
+  can `jax.export.deserialize(blob).call(z)`.
+
+Usage:
+    python -m dcgan_tpu.export --checkpoint_dir ckpt --out sampler.jaxexport
+    python -m dcgan_tpu.export --checkpoint_dir ckpt/best --use_ema \
+        --out sampler.jaxexport --platforms cpu tpu
+
+    # serving side (no dcgan_tpu import needed):
+    blob = open("sampler.jaxexport", "rb").read()
+    images = jax.export.deserialize(blob).call(z)          # z ~ U(-1,1)
+    # conditional checkpoints:    ...call(z, labels)       # labels int32
+
+A JSON sidecar (`<out>.json`) records the calling convention: z_dim,
+num_classes, image shape, checkpoint step, weight source (live vs EMA).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import List, Optional, Sequence
+
+Pytree = dict
+
+
+def export_sampler(checkpoint_dir: str, out_path: str, *,
+                   preset: Optional[str] = None,
+                   overrides: Optional[dict] = None,
+                   use_ema: bool = False,
+                   platforms: Sequence[str] = ("cpu", "tpu"),
+                   batch_size: int = 0) -> dict:
+    """Bake the checkpoint's generator into a serialized artifact.
+
+    batch_size=0 exports a symbolic batch dimension (serve any batch size);
+    a positive value pins it (some embedders prefer static shapes).
+    Returns the sidecar metadata dict.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    from dcgan_tpu.config import TrainConfig, resolve_model_config
+    from dcgan_tpu.models.dcgan import sampler_apply
+    from dcgan_tpu.parallel import make_mesh, make_parallel_train
+    from dcgan_tpu.utils.checkpoint import Checkpointer
+
+    mcfg = resolve_model_config(checkpoint_dir, preset=preset,
+                                overrides=overrides)
+    # The artifact must be pure StableHLO: pallas_call lowers to a
+    # TPU-specific custom call that would pin the bytes to one backend
+    # generation, and the kernels are a capability for the long-context
+    # path, not the sampler (DESIGN.md §8b). Same image, standard lowering.
+    mcfg = dataclasses.replace(mcfg, use_pallas=False)
+
+    cfg = TrainConfig(model=mcfg, batch_size=1, checkpoint_dir=checkpoint_dir)
+    pt = make_parallel_train(cfg, make_mesh(cfg.mesh))
+    restored = Checkpointer(checkpoint_dir).restore_latest(
+        pt.init(jax.random.key(0)))
+    if restored is None:
+        raise SystemExit(f"no checkpoint under {checkpoint_dir}")
+    # Host copies: the weights enter the traced function as constants, so
+    # the serialized artifact embeds them and serves with no state of its own.
+    state = jax.device_get(restored)
+    step = int(state["step"])
+    g_params = state["ema_gen"] if use_ema else state["params"]["gen"]
+    bn_gen = state["bn"]["gen"]
+
+    def sample_fn(z, labels=None):
+        return sampler_apply(g_params, bn_gen, z, cfg=mcfg, labels=labels)
+
+    if batch_size > 0:
+        b = batch_size
+    else:
+        (b,) = jexport.symbolic_shape("b")
+    z_spec = jax.ShapeDtypeStruct((b, mcfg.z_dim), jnp.float32)
+    specs = ((z_spec, jax.ShapeDtypeStruct((b,), jnp.int32))
+             if mcfg.num_classes else (z_spec,))
+
+    exported = jexport.export(jax.jit(sample_fn),
+                              platforms=tuple(platforms))(*specs)
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with open(out_path, "wb") as f:
+        f.write(blob)
+
+    meta = {
+        "format": "jax.export serialized StableHLO",
+        "call": ("(z[b, z_dim] f32, labels[b] i32) -> images"
+                 if mcfg.num_classes else "(z[b, z_dim] f32) -> images"),
+        "z_dim": mcfg.z_dim,
+        "num_classes": mcfg.num_classes or 0,
+        "image_shape": [mcfg.output_size, mcfg.output_size, mcfg.c_dim],
+        "batch": batch_size if batch_size > 0 else "b (symbolic)",
+        "arch": mcfg.arch,
+        "step": step,
+        "weights": "ema" if use_ema else "live",
+        "platforms": list(platforms),
+        "bytes": len(blob),
+    }
+    with open(out_path + ".json", "w") as f:
+        json.dump(meta, f, indent=2)
+    return meta
+
+
+def load_sampler(path: str):
+    """Deserialize an exported sampler; returns the `Exported` (use `.call`).
+
+    Provided for symmetry/tests — serving does not need this module
+    (`jax.export.deserialize` on the raw bytes is the whole protocol).
+    """
+    from jax import export as jexport
+
+    with open(path, "rb") as f:
+        return jexport.deserialize(f.read())
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from dcgan_tpu.config import add_model_override_flags
+
+    p = argparse.ArgumentParser(
+        prog="dcgan_tpu.export",
+        description="export a trained sampler as one portable StableHLO "
+                    "artifact (weights baked in)")
+    p.add_argument("--checkpoint_dir", required=True)
+    p.add_argument("--out", default="sampler.jaxexport")
+    p.add_argument("--use_ema", action="store_true",
+                   help="bake the EMA generator weights instead of the live "
+                        "ones")
+    p.add_argument("--platforms", nargs="+", default=["cpu", "tpu"],
+                   help="XLA backends the artifact is lowered for")
+    p.add_argument("--batch_size", type=int, default=0,
+                   help="pin the batch dimension (default 0 = symbolic: any "
+                        "batch size at call time)")
+    p.add_argument("--preset", default=None,
+                   help="named config supplying the architecture instead of "
+                        "the checkpoint's config.json")
+    add_model_override_flags(p)  # same surface as generate/evals
+    p.add_argument("--platform", default=None,
+                   help="JAX platform to trace/export under (e.g. cpu)")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from dcgan_tpu.config import MODEL_OVERRIDE_FLAGS
+
+    meta = export_sampler(
+        args.checkpoint_dir, args.out, preset=args.preset,
+        overrides={n: getattr(args, n) for n in MODEL_OVERRIDE_FLAGS},
+        use_ema=args.use_ema, platforms=args.platforms,
+        batch_size=args.batch_size)
+    print(f"[dcgan_tpu.export] step-{meta['step']} {meta['weights']} "
+          f"sampler ({meta['arch']}, {meta['bytes']} bytes, "
+          f"platforms {','.join(meta['platforms'])}) -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()

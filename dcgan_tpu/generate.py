@@ -45,27 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="named config (presets.py) supplying the model "
                         "architecture instead of the checkpoint's "
                         "config.json; explicit flags override")
-    p.add_argument("--arch", choices=["dcgan", "resnet", "stylegan"],
-                   default=None,
-                   help="match the checkpoint's model family")
-    p.add_argument("--output_size", type=int, default=None)
-    p.add_argument("--c_dim", type=int, default=None)
-    p.add_argument("--z_dim", type=int, default=None)
-    p.add_argument("--gf_dim", type=int, default=None)
-    p.add_argument("--df_dim", type=int, default=None)
-    p.add_argument("--num_classes", type=int, default=None)
-    p.add_argument("--attn_res", type=int, default=None,
-                   help="match the checkpoint's attention config "
-                        "(presets supply it; explicit flag overrides)")
-    p.add_argument("--attn_heads", type=int, default=None,
-                   help="match the checkpoint's attention head count")
-    p.add_argument("--spectral_norm", choices=["none", "d", "gd"],
-                   default=None,
-                   help="match the checkpoint's spectral-norm config")
-    p.add_argument("--conditional_bn", action=argparse.BooleanOptionalAction,
-                   default=None,
-                   help="match the checkpoint's conditional-BN config "
-                        "([K, C] per-class BN tables in G)")
+    from dcgan_tpu.config import add_model_override_flags
+
+    add_model_override_flags(p)
     p.add_argument("--class_id", type=int, default=None,
                    help="conditional models: generate only this class "
                         "(default: cycle all classes)")

@@ -75,12 +75,21 @@ def attn_init(key, ch: int, *, dtype=jnp.float32) -> Pytree:
 
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    *, scale: float) -> jax.Array:
-    """softmax(q k^T * scale) v over the whole sequence. [B,S,d] each; the
-    softmax/accumulation run in float32 whatever the input dtype."""
-    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    """softmax(q k^T * scale) v over the whole sequence. [B,S,d] each.
+
+    Precision policy (all execution forms share it): matmul OPERANDS keep
+    their input dtype — bf16 rides the MXU fast path instead of being
+    upcast into 4x-slower f32 matmuls — while scores/softmax/accumulation
+    are float32 via `preferred_element_type`. The probability matrix is
+    cast back to the value dtype for the PV matmul (the flash-attention
+    recipe, arXiv:2205.14135 §3.1). float32 inputs take the exact float32
+    path unchanged — the policy is dtype-gated, not a global downcast.
+    """
+    s = jnp.einsum("bqd,bkd->bqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bqk,bkv->bqv", p, v.astype(jnp.float32))
+    return jnp.einsum("bqk,bkv->bqv", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
@@ -100,10 +109,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if n_shards == 1:
         return full_attention(q, k, v, scale=scale)
     fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
-    qf = q.astype(jnp.float32)
 
     def fold(k_blk, v_blk, m, l, acc):
-        s = jnp.einsum("bqd,bkd->bqk", qf, k_blk.astype(jnp.float32)) * scale
+        # same precision policy as full_attention: operands in input dtype,
+        # scores/stats/accumulator f32 via preferred_element_type
+        s = jnp.einsum("bqd,bkd->bqk", q, k_blk,
+                       preferred_element_type=jnp.float32) * scale
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         # exp(-inf - -inf) cannot occur: m_new is finite from the first fold
         # on, and there m = -inf only on the correction side
@@ -113,14 +124,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[..., None] + jnp.einsum(
-            "bqk,bkv->bqv", p, v_blk.astype(jnp.float32))
+            "bqk,bkv->bqv", p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
         return m_new, l, acc
 
     # Build the accumulators out of q/v arithmetic (not jnp.zeros) so they
     # inherit the operands' device-varying axes — the scan carry then
     # type-checks under shard_map's VMA tracking over ANY enclosing mesh
     # (the ring axis alone, or ring + a batch axis).
-    zero_q = qf[..., 0] * 0.0                       # [B, S]
+    zero_q = q[..., 0].astype(jnp.float32) * 0.0    # [B, S]
     m, l, acc = fold(k, v, zero_q - jnp.inf, zero_q,
                      zero_q[..., None] * v[:, :1, :].astype(jnp.float32))
 

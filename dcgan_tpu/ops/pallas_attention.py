@@ -36,13 +36,22 @@ are not nested.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-BLOCK = 128  # MXU edge; q/k tile rows
+# Tile sizes. 128 is the MXU edge; larger tiles amortize the serialized
+# inner-loop overhead (the per-tile softmax state update is loop-carried, so
+# tile count — not matmul rate — dominates at the head dims this model uses).
+# Overridable per process via the DCGAN_FLASH_TQ / DCGAN_FLASH_TK env vars
+# (read at call time — set them around tools/bench_attention.py runs to
+# sweep tilings on a chip); the defaults are the measured-best config.
+BLOCK_Q = 128
+BLOCK_K = 128
 
 _NEG_INF = -1e30  # finite stand-in for -inf: keeps exp()/max() NaN-free
 
@@ -51,14 +60,39 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _block(s: int) -> int:
-    """Largest tile <= BLOCK dividing s (sequence lengths here are powers of
-    two times small factors; a divisor always exists for the supported
-    shapes)."""
-    b = min(s, BLOCK)
-    while s % b:
-        b -= 1
-    return b
+def _compiler_params():
+    """Grid programs are independent (softmax state is loop-carried INSIDE a
+    program, never across grid steps), so both grid axes are parallel."""
+    if _interpret():
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel"))
+
+
+def _tile(s: int, which: str, default: int) -> int:
+    """Largest tile <= the configured target dividing s, subject to the
+    Mosaic constraint that non-full block dims be multiples of 8 (sequence
+    lengths here are powers of two times small factors, so such a divisor
+    exists for every supported shape; if none does, the full sequence is
+    always a legal block)."""
+    raw = os.environ.get(f"DCGAN_FLASH_{which}", default)
+    try:
+        target = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"DCGAN_FLASH_{which}={raw!r} is not an integer") from None
+    if target < 1:
+        raise ValueError(f"DCGAN_FLASH_{which}={target} must be >= 1")
+    if target >= s:
+        return s
+    for b in range(min(s, target), 7, -1):
+        if s % b == 0 and b % 8 == 0:
+            return b
+    return s
+
+
+def _blocks(s: int) -> tuple:
+    return _tile(s, "TQ", BLOCK_Q), _tile(s, "TK", BLOCK_K)
 
 
 # ---------------------------------------------------------------------------
@@ -66,22 +100,29 @@ def _block(s: int) -> int:
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, tk):
-    q = q_ref[0].astype(jnp.float32)                    # [TQ, d]
+    # Precision policy (shared with ops/attention.py::full_attention):
+    # matmul operands stay in the INPUT dtype — bf16 rides the MXU fast
+    # path — while scores/stats/accumulator are f32 via
+    # preferred_element_type; p is cast back to the operand dtype for the
+    # PV matmul (the flash-attention recipe). f32 inputs take the exact
+    # f32 path unchanged.
+    q = q_ref[0]                                        # [TQ, d]
+    mmdt = q.dtype
     tq = q.shape[0]
     dv = v_ref.shape[-1]
     n_k = k_ref.shape[1] // tk
 
     def body(j, carry):
         m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * tk, tk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * tk, tk), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(j * tk, tk), :]
+        vb = v_ref[0, pl.ds(j * tk, tk), :]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.dot(p, vb,
+        acc = acc * corr + jnp.dot(p.astype(mmdt), vb,
                                    preferred_element_type=jnp.float32)
         return m_new, l, acc
 
@@ -100,7 +141,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, tk):
 def _fwd_impl(q, k, v, scale):
     B, S, dk = q.shape
     dv = v.shape[-1]
-    tq, tk = _block(S), _block(S)
+    tq, tk = _blocks(S)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, tk=tk),
         grid=(B, S // tq),
@@ -111,6 +152,7 @@ def _fwd_impl(q, k, v, scale):
                    pl.BlockSpec((1, tq, 1), lambda b, i: (b, i, 0))),
         out_shape=(jax.ShapeDtypeStruct((B, S, dv), jnp.float32),
                    jax.ShapeDtypeStruct((B, S, 1), jnp.float32)),
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v)
     return out, lse
@@ -122,23 +164,27 @@ def _fwd_impl(q, k, v, scale):
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                scale, tk):
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    # same operand-dtype / f32-accumulation policy as the forward; the
+    # cotangent do arrives f32 (flash_attention returns f32) and is cast
+    # once to the operand dtype for its matmuls
+    q = q_ref[0]
+    mmdt = q.dtype
+    do = do_ref[0].astype(mmdt)
     lse = lse_ref[0]                                     # [TQ, 1]
     delta = delta_ref[0]                                 # [TQ, 1]
     tq, dk = q.shape
     n_k = k_ref.shape[1] // tk
 
     def body(j, dq):
-        kb = k_ref[0, pl.ds(j * tk, tk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * tk, tk), :].astype(jnp.float32)
+        kb = k_ref[0, pl.ds(j * tk, tk), :]
+        vb = v_ref[0, pl.ds(j * tk, tk), :]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)                             # [TQ, TK]
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        return dq + jnp.dot(ds, kb,
+        return dq + jnp.dot(ds.astype(mmdt), kb,
                             preferred_element_type=jnp.float32) * scale
 
     dq = lax.fori_loop(0, n_k, body, jnp.zeros((tq, dk), jnp.float32))
@@ -147,16 +193,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, scale, tq):
-    kb = k_ref[0].astype(jnp.float32)                    # [TK, dk]
-    vb = v_ref[0].astype(jnp.float32)                    # [TK, dv]
+    kb = k_ref[0]                                        # [TK, dk]
+    vb = v_ref[0]                                        # [TK, dv]
+    mmdt = kb.dtype
     tk, dkd = kb.shape
     dvd = vb.shape[-1]
     n_q = q_ref.shape[1] // tq
 
     def body(i, carry):
         dk_acc, dv_acc = carry
-        q = q_ref[0, pl.ds(i * tq, tq), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * tq, tq), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(i * tq, tq), :]
+        do = do_ref[0, pl.ds(i * tq, tq), :].astype(mmdt)
         lse = lse_ref[0, pl.ds(i * tq, tq), :]           # [TQ, 1]
         delta = delta_ref[0, pl.ds(i * tq, tq), :]       # [TQ, 1]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
@@ -166,10 +213,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta)                            # [TQ, TK]
         dk_acc = dk_acc + jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(mmdt), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         dv_acc = dv_acc + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(mmdt), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return dk_acc, dv_acc
 
@@ -184,7 +231,7 @@ def _bwd_impl(scale, res, g):
     q, k, v, out, lse = res
     B, S, dk = q.shape
     dv = v.shape[-1]
-    tq, tk = _block(S), _block(S)
+    tq, tk = _blocks(S)
     # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
     # one fused elementwise reduction, XLA handles it. [B, S, 1] like lse.
     delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1,
@@ -201,6 +248,7 @@ def _bwd_impl(scale, res, g):
                   pl.BlockSpec((1, tq, 1), lambda b, i: (b, i, 0))],
         out_specs=pl.BlockSpec((1, tq, dk), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, S, dk), q.dtype),
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v, g, lse, delta)
 
@@ -217,6 +265,7 @@ def _bwd_impl(scale, res, g):
                    pl.BlockSpec((1, tk, dv), lambda b, j: (b, j, 0))),
         out_shape=(jax.ShapeDtypeStruct((B, S, dk), k.dtype),
                    jax.ShapeDtypeStruct((B, S, dv), v.dtype)),
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v, g, lse, delta)
     return dq.astype(q.dtype), dk_arr, dv_arr

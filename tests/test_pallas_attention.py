@@ -67,6 +67,32 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-2)
 
+    def test_bad_tile_env_raises(self, monkeypatch):
+        q, k, v = qkv(S=128)
+        for bad in ("0", "-8", "garbage"):
+            monkeypatch.setenv("DCGAN_FLASH_TQ", bad)
+            with pytest.raises(ValueError, match="DCGAN_FLASH_TQ"):
+                flash_attention(q, k, v, 0.1)
+
+    @pytest.mark.parametrize("tq,tk", [("64", "32"), ("256", "128")])
+    def test_tuned_tile_sizes_stay_exact(self, tq, tk, monkeypatch):
+        # DCGAN_FLASH_TQ/TK are the chip-tuning knobs (read per call); any
+        # divisor config must be bit-compatible with the default tiling
+        q, k, v = qkv(S=256)
+        scale = q.shape[-1] ** -0.5
+        ref = full_attention(q, k, v, scale=scale)
+        monkeypatch.setenv("DCGAN_FLASH_TQ", tq)
+        monkeypatch.setenv("DCGAN_FLASH_TK", tk)
+        out = flash_attention(q, k, v, scale)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6)
+        g_ref = jax.grad(lambda q: jnp.sum(
+            full_attention(q, k, v, scale=scale) ** 2))(q)
+        g_fl = jax.grad(lambda q: jnp.sum(
+            flash_attention(q, k, v, scale) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g_fl), np.asarray(g_ref),
+                                   atol=2e-5)
+
 
 class TestFusedAttnApply:
     def test_use_pallas_matches_dense_block(self):

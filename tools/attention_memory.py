@@ -80,17 +80,23 @@ def main() -> None:
                                   "error": f"{type(e).__name__}: "
                                            f"{str(e)[:120]}"}), flush=True)
 
+    # fit temp ~ c*S^2 + fixed by least squares over ALL measured dense
+    # points (dedup'd — repeated/unsorted --seq must not skew or crash the
+    # fit; the quadratic term dominates at large S, small-S rows carry the
+    # fixed overhead the intercept absorbs)
+    dense_pts = sorted(dict(dense_pts).items())
     if len(dense_pts) >= 2:
-        # fit temp ~ c * S^2 on the largest points (the quadratic term
-        # dominates there; small-S rows carry fixed overheads)
-        (s1, t1), (s2, t2) = dense_pts[-2], dense_pts[-1]
-        c = (t2 - t1) / (s2 ** 2 - s1 ** 2)
-        fixed = t2 - c * s2 ** 2
+        import numpy as np
+
+        s2 = np.asarray([s ** 2 for s, _ in dense_pts], dtype=np.float64)
+        t = np.asarray([t for _, t in dense_pts], dtype=np.float64)
+        a = np.stack([s2, np.ones_like(s2)], axis=1)
+        (c, fixed), *_ = np.linalg.lstsq(a, t, rcond=None)
         hbm = args.hbm_gib * 2**30
         s_wall = int(((hbm - fixed) / c) ** 0.5) if c > 0 else None
         print(json.dumps({
             "label": "attention-memory",
-            "dense_s2_bytes_coeff": c,
+            "dense_s2_bytes_coeff": round(float(c), 4),
             "projected_dense_wall_seq": s_wall,
             "hbm_gib": args.hbm_gib,
         }), flush=True)
