@@ -80,7 +80,8 @@ def _bench_sample(cfg, pt, state, n_chips: int) -> None:
 
     img_per_sec_chip = batch * n_calls / dt / n_chips
     arch = os.environ.get("BENCH_PRESET", "") or (
-        "SAGAN-64" if cfg.model.attn_res else "DCGAN-64")
+        f"SAGAN-{cfg.model.output_size}" if cfg.model.attn_res
+        else f"DCGAN-{cfg.model.output_size}")
     print(json.dumps({
         "metric": f"{arch} sampler (inference) throughput "
                   f"(batch {batch // n_chips}/chip, bf16)",
@@ -128,6 +129,9 @@ def main() -> None:
     else:
         cfg = TrainConfig(
             model=ModelConfig(          # 64x64, gf=df=64, bf16 compute
+                # BENCH_SIZE: output resolution (default 64; 256 is the
+                # long-context config — attention at 128x128 = S 16384)
+                output_size=int(os.environ.get("BENCH_SIZE", 64)),
                 use_pallas=os.environ.get("BENCH_PALLAS", "") == "1",
                 # BENCH_ATTN=1: the sagan64 architecture (self-attention at
                 # 32x32); with BENCH_PALLAS=1 the block runs the flash
@@ -143,6 +147,21 @@ def main() -> None:
             # other BENCH_* model knobs rather than forking its own config.
             grad_accum=int(os.environ.get("BENCH_ACCUM", 1)),
             backend=os.environ.get("BENCH_BACKEND", "gspmd"))
+    if os.environ.get("BENCH_ATTN_RES"):
+        # BENCH_ATTN_RES=R: self-attention at an arbitrary feature-map
+        # resolution (sequence length R*R) on top of WHATEVER config was
+        # built above — preset or default. This is the long-context bench
+        # knob: at R=128 (S=16384) the dense [S, S] form cannot allocate at
+        # train batch sizes and only the flash path runs (DESIGN.md §8).
+        import dataclasses
+
+        model_kw = {"attn_res": int(os.environ["BENCH_ATTN_RES"])}
+        if "BENCH_PALLAS" in os.environ:
+            # only override when explicitly set — a preset's own use_pallas
+            # must survive an attn_res-only override
+            model_kw["use_pallas"] = os.environ["BENCH_PALLAS"] == "1"
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, **model_kw))
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
 
@@ -211,7 +230,8 @@ def main() -> None:
     if preset_name:
         arch = preset_name
     else:
-        arch = "SAGAN-64" if cfg.model.attn_res else "DCGAN-64"
+        arch = (f"SAGAN-{cfg.model.output_size}" if cfg.model.attn_res
+                else f"DCGAN-{cfg.model.output_size}")
         if cfg.grad_accum > 1:
             arch += f" grad_accum={cfg.grad_accum}"
     print(json.dumps({

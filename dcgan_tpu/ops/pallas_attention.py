@@ -12,9 +12,11 @@ instead of reading a stored probability matrix. O(S) HBM traffic in S instead
 of O(S^2) — the property that makes sequence length a free axis.
 
 Layout notes (TPU):
-- Blocks are [TQ, d] / [TK, d] with TQ = TK = 128 (the MXU systolic edge);
-  `q @ k^T` and `p @ v` land on the MXU with f32 accumulation
-  (`preferred_element_type`).
+- Blocks are [TQ, d] / [TK, d] with TQ = 256, TK = 1024 (chip-swept, see
+  BLOCK_Q/BLOCK_K below — NOT the 128 MXU edge: the systolic array stays
+  busy either way, and wide k-tiles quarter the serialized online-softmax
+  iterations); `q @ k^T` and `p @ v` land on the MXU in the input dtype
+  with f32 accumulation (`preferred_element_type`).
 - Grid is (B, S/TQ) for forward/dq and (B, S/TK) for dk/dv — the kernel loops
   over the opposite axis with `lax.fori_loop`, keeping per-program state in
   VMEM scratch.
@@ -44,14 +46,16 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Tile sizes. 128 is the MXU edge; larger tiles amortize the serialized
-# inner-loop overhead (the per-tile softmax state update is loop-carried, so
-# tile count — not matmul rate — dominates at the head dims this model uses).
-# Overridable per process via the DCGAN_FLASH_TQ / DCGAN_FLASH_TK env vars
-# (read at call time — set them around tools/bench_attention.py runs to
-# sweep tilings on a chip); the defaults are the measured-best config.
-BLOCK_Q = 128
-BLOCK_K = 128
+# Tile sizes. The per-tile softmax state update is loop-carried, so tile
+# COUNT — not matmul rate — dominates at the head dims this model uses;
+# large k-tiles amortize that serialization. (256, 1024) is the chip-swept
+# optimum (v5e, S=16384 fwd+bwd: 11.95 ms vs 28.9 at the naive MXU-edge
+# 128/128 and 21.6 dense; at S=40960 flash 35.96 ms vs dense 80.66 — the
+# sweep grid and every measured cell are in DESIGN.md §8). Overridable per
+# process via the DCGAN_FLASH_TQ / DCGAN_FLASH_TK env vars (read at call
+# time — set them around tools/bench_attention.py runs to re-sweep).
+BLOCK_Q = 256
+BLOCK_K = 1024
 
 _NEG_INF = -1e30  # finite stand-in for -inf: keeps exp()/max() NaN-free
 
@@ -66,7 +70,11 @@ def _compiler_params():
     if _interpret():
         return None
     return pltpu.CompilerParams(
-        dimension_semantics=("parallel", "parallel"))
+        dimension_semantics=("parallel", "parallel"),
+        # the dkv kernel holds full-sequence q/do residents (double-buffered
+        # across the batch grid axis); the default VMEM budget is tighter
+        # than the hardware's — claim most of the 128 MiB explicitly
+        vmem_limit_bytes=100 * 1024 * 1024)
 
 
 def _tile(s: int, which: str, default: int) -> int:
@@ -165,11 +173,10 @@ def _fwd_impl(q, k, v, scale):
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
                scale, tk):
     # same operand-dtype / f32-accumulation policy as the forward; the
-    # cotangent do arrives f32 (flash_attention returns f32) and is cast
-    # once to the operand dtype for its matmuls
+    # cotangent do arrives pre-cast to the operand dtype (_bwd_impl)
     q = q_ref[0]
     mmdt = q.dtype
-    do = do_ref[0].astype(mmdt)
+    do = do_ref[0]
     lse = lse_ref[0]                                     # [TQ, 1]
     delta = delta_ref[0]                                 # [TQ, 1]
     tq, dk = q.shape
@@ -193,6 +200,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, *, scale, tq):
+    # This kernel walks ALL q-tiles per program, so q/do/lse/delta enter as
+    # full-sequence residents. lse/delta arrive packed [1, 1, S] (sequence
+    # on the LANE axis — a [S, 1] layout would lane-pad 128x and scale
+    # VMEM residency with S, which walled compilation at large S/batch);
+    # do arrives pre-cast to the operand dtype by _bwd_impl.
     kb = k_ref[0]                                        # [TK, dk]
     vb = v_ref[0]                                        # [TK, dv]
     mmdt = kb.dtype
@@ -203,9 +215,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def body(i, carry):
         dk_acc, dv_acc = carry
         q = q_ref[0, pl.ds(i * tq, tq), :]
-        do = do_ref[0, pl.ds(i * tq, tq), :].astype(mmdt)
-        lse = lse_ref[0, pl.ds(i * tq, tq), :]           # [TQ, 1]
-        delta = delta_ref[0, pl.ds(i * tq, tq), :]       # [TQ, 1]
+        do = do_ref[0, pl.ds(i * tq, tq), :]
+        lse = lse_ref[0, 0, pl.ds(i * tq, tq)][:, None]  # [TQ, 1]
+        delta = delta_ref[0, 0, pl.ds(i * tq, tq)][:, None]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         p = jnp.exp(s - lse)                             # [TQ, TK]
@@ -236,6 +248,15 @@ def _bwd_impl(scale, res, g):
     # one fused elementwise reduction, XLA handles it. [B, S, 1] like lse.
     delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1,
                     keepdims=True)
+    # cast the f32 cotangent to the matmul operand dtype ONCE, outside the
+    # kernels — under bf16 it halves do's HBM traffic and its full-array
+    # VMEM residency in the dkv kernel
+    do = g.astype(q.dtype)
+    # lane-major packing for the two per-row stats: the dkv kernel holds
+    # them full-sequence, and a [S, 1] block lane-pads 128x (8 MiB at
+    # S=16384 where 64 KiB is the data) — [1, S] keeps S on the lane axis
+    lse_r = lse.reshape(B, 1, S)
+    delta_r = delta.reshape(B, 1, S)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, tk=tk),
@@ -250,7 +271,7 @@ def _bwd_impl(scale, res, g):
         out_shape=jax.ShapeDtypeStruct((B, S, dk), q.dtype),
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, do, lse, delta)
 
     dk_arr, dv_arr = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, tq=tq),
@@ -259,15 +280,15 @@ def _bwd_impl(scale, res, g):
                   pl.BlockSpec((1, tk, dk), lambda b, j: (b, j, 0)),
                   pl.BlockSpec((1, tk, dv), lambda b, j: (b, j, 0)),
                   pl.BlockSpec((1, S, dv), lambda b, j: (b, 0, 0)),
-                  pl.BlockSpec((1, S, 1), lambda b, j: (b, 0, 0)),
-                  pl.BlockSpec((1, S, 1), lambda b, j: (b, 0, 0))],
+                  pl.BlockSpec((1, 1, S), lambda b, j: (b, 0, 0)),
+                  pl.BlockSpec((1, 1, S), lambda b, j: (b, 0, 0))],
         out_specs=(pl.BlockSpec((1, tk, dk), lambda b, j: (b, j, 0)),
                    pl.BlockSpec((1, tk, dv), lambda b, j: (b, j, 0))),
         out_shape=(jax.ShapeDtypeStruct((B, S, dk), k.dtype),
                    jax.ShapeDtypeStruct((B, S, dv), v.dtype)),
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q, k, v, g, lse, delta)
+    )(q, k, v, do, lse_r, delta_r)
     return dq.astype(q.dtype), dk_arr, dv_arr
 
 

@@ -92,6 +92,26 @@ STEPS = [
     _bench("dcgan64-b1024", BENCH_BATCH="1024"),
     _bench("dcgan64-accum4", BENCH_ACCUM="4"),
     _bench("stylegan64", BENCH_PRESET="stylegan64"),
+    # Long-context IN-MODEL rows (DESIGN.md §8): self-attention over the
+    # 128x128 feature map (S = 16384) inside a 256x256 DCGAN train step.
+    # At batch 8 both forms fit and flash measures ~3.4x faster (the [S, S]
+    # materialization is pure overhead); at the reference's batch-64
+    # contract the dense form needs a 64 GiB f32[64, 16384, 16384] score
+    # buffer and CANNOT allocate (the compiler names it in the error) —
+    # its recorded failure is the measurement, and the flash row at the
+    # same batch is the capability.
+    _bench("dcgan256-attn128-flash", timeout=600, BENCH_SIZE="256",
+           BENCH_ATTN_RES="128", BENCH_PALLAS="1", BENCH_BATCH="8",
+           BENCH_STEPS="100", BENCH_SCAN="10"),
+    _bench("dcgan256-attn128-dense", timeout=600, BENCH_SIZE="256",
+           BENCH_ATTN_RES="128", BENCH_BATCH="8",
+           BENCH_STEPS="100", BENCH_SCAN="10"),
+    _bench("dcgan256-attn128-flash-b64", timeout=900, BENCH_SIZE="256",
+           BENCH_ATTN_RES="128", BENCH_PALLAS="1", BENCH_BATCH="64",
+           BENCH_STEPS="40", BENCH_SCAN="5"),
+    _bench("dcgan256-attn128-dense-b64", timeout=600, BENCH_SIZE="256",
+           BENCH_ATTN_RES="128", BENCH_BATCH="64",
+           BENCH_STEPS="40", BENCH_SCAN="5"),
     ("attention", "attn-crossover-small",
      [sys.executable, "tools/bench_attention.py",
       "--seq", "1024", "4096", "16384"], {}, 600, True),
@@ -100,7 +120,8 @@ STEPS = [
       "--seq", "32768", "40960", "45056", "49152", "65536"], {}, 900, True),
     ("attention", "attn-memory",
      [sys.executable, "tools/attention_memory.py",
-      "--seq", "8192", "16384", "32768", "40960", "45056", "49152"],
+      "--seq", "8192", "16384", "32768", "40960", "45056", "49152",
+      "65536"],
      {}, 900, True),
     ("roofline", "matmul-rate", [sys.executable, "tools/matmul_rate.py"],
      {}, 600, True),
@@ -228,16 +249,39 @@ def _attention_rows(rows):
     maps; memory rows come from tools/attention_memory.py (temp_mib)."""
     out = {}
     mem = {}
+    # Timing rows are selected as PAIRS: per seq, the single harvest run
+    # whose dense+flash measurements (which share one tunnel window) have
+    # the lowest combined ms — a per-cell best-of would splice forms from
+    # different windows and corrupt the dense/flash ratio the table exists
+    # to show. A run with an error row is only selected while no run has a
+    # complete pair for that seq (the dense wall rows stay visible).
+    pairs = {}   # seq -> {form: row} of the selected run
     for r in rows:
         if r["section"] != "attention":
             continue
+        by_seq = {}
         for p in r.get("parsed", []):
             if "form" not in p or "seq" not in p:
                 continue
             if r["label"] == "attn-memory":
+                # memory rows are exact program properties of the CURRENT
+                # kernels (the dense coefficient changed 8->6 bytes/S^2
+                # with the precision policy) — keep the latest
                 mem[(p["form"], p["seq"])] = dict(p, date=r["date"])
             else:
-                out[(p["form"], p["seq"])] = dict(p, date=r["date"])
+                by_seq.setdefault(p["seq"], {})[p["form"]] = \
+                    dict(p, date=r["date"])
+        def _score(cand):
+            oks = [p["ms"] for p in cand.values() if "ms" in p]
+            # complete pairs first (fewer errors), then fastest window
+            return (len(cand) - len(oks), sum(oks))
+        for seq, cand in by_seq.items():
+            cur = pairs.get(seq)
+            if cur is None or _score(cand) < _score(cur):
+                pairs[seq] = cand
+    for cand in pairs.values():
+        for p in cand.values():
+            out[(p["form"], p["seq"])] = p
     return out, mem
 
 
