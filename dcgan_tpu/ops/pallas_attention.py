@@ -57,6 +57,15 @@ from jax.experimental.pallas import tpu as pltpu
 BLOCK_Q = 256
 BLOCK_K = 1024
 
+# Measurement generation: bump on ANY change that alters attention-kernel
+# performance characteristics (tile defaults, precision policy, block
+# layouts). tools/bench_attention.py stamps it into every timing row and
+# tools/capture_all.py publishes only the highest generation present per
+# sequence length — so crossover tables never mix measurements of
+# different kernel code. Gen 2 = bf16-operand policy + (256, 1024) tiles +
+# lane-major backward stats.
+ATTN_GEN = 2
+
 _NEG_INF = -1e30  # finite stand-in for -inf: keeps exp()/max() NaN-free
 
 

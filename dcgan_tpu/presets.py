@@ -118,6 +118,25 @@ def sagan128(**overrides) -> TrainConfig:
     return dataclasses.replace(cfg, **overrides)
 
 
+def sagan256_lc(**overrides) -> TrainConfig:
+    """The long-context configuration: 256x256 DCGAN stacks with attention
+    over the 128x128 feature map — a 16 384-token sequence — on the flash
+    kernels (use_pallas). This is the config the chip measurements pin as
+    flash-ONLY at the reference's batch 64: XLA's dense lowering needs a
+    64 GiB f32[64, 16384, 16384] score buffer and cannot allocate, while
+    the flash path trains at 51.3 img/s (BASELINE.md dcgan256-attn128-*
+    rows; DESIGN.md §8/8b). SAGAN recipe (hinge, SN on D, TTUR, EMA); SN
+    is D-only here — G's 2048-channel early stages make G-side power
+    iteration the dominant non-attention cost at this depth."""
+    cfg = _build(ModelConfig(output_size=256, attn_res=128,
+                             spectral_norm="d", use_pallas=True),
+                 MeshConfig(),
+                 batch_size=64, loss="hinge", beta1=0.0,
+                 d_learning_rate=4e-4, g_learning_rate=1e-4,
+                 g_ema_decay=0.999)
+    return dataclasses.replace(cfg, **overrides)
+
+
 def sngan_cifar10(**overrides) -> TrainConfig:
     """SNGAN on CIFAR-10 (32x32), after Miyato et al. 2018 (table 3):
     residual G/D, norm-free spectrally-normalized critic, hinge loss,
@@ -157,6 +176,7 @@ PRESETS: Dict[str, Callable[..., TrainConfig]] = {
     "wgan-gp": wgan_gp,
     "sagan64": sagan64,
     "sagan128": sagan128,
+    "sagan256-lc": sagan256_lc,
     "sngan-cifar10": sngan_cifar10,
     "stylegan64": stylegan64,
 }

@@ -40,9 +40,19 @@ def main() -> None:
     # MH_SPC > 1: the scanned multi-step dispatch (steps_per_call) under a
     # real 2-process job — cadences must be multiples of the call size
     spc = int(os.environ.get("MH_SPC", "1"))
+    # MH_SPATIAL=1: the distributed long-context path — image height
+    # sharded over a 2-way "model" axis with ring attention (ppermute k/v
+    # around the sequence axis) running under the SAME jax.distributed job
+    # that carries the data-parallel gradient psums over localhost DCN
+    spatial = os.environ.get("MH_SPATIAL") == "1"
+    from dcgan_tpu.config import MeshConfig
+
     cfg = TrainConfig(
         model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
-                          compute_dtype="float32"),
+                          compute_dtype="float32",
+                          attn_res=8 if spatial else 0),
+        mesh=(MeshConfig(model=2, spatial=True) if spatial
+              else MeshConfig()),
         batch_size=16,                       # global; 8 per process
         backend=backend,
         checkpoint_dir=os.path.join(workdir, "ckpt"),

@@ -30,7 +30,7 @@ def _free_port() -> int:
 
 
 def _run_job(tmp_path, backend: str, *, fid: bool = False,
-             steps_per_call: int = 1) -> None:
+             steps_per_call: int = 1, spatial: bool = False) -> None:
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -44,6 +44,7 @@ def _run_job(tmp_path, backend: str, *, fid: bool = False,
             "MH_BACKEND": backend,
             "MH_FID": "1" if fid else "0",
             "MH_SPC": str(steps_per_call),
+            "MH_SPATIAL": "1" if spatial else "0",
             "PYTHONPATH": _REPO,
         })
         procs.append(subprocess.Popen(
@@ -112,6 +113,15 @@ def test_two_process_fid_probe_and_best_retention(tmp_path):
     # the retained score matches one of the probed eval/fid values
     probed = {round(e["values"]["eval/fid"], 6) for e in fid_events}
     assert round(score["fid"], 6) in probed
+
+
+def test_two_process_spatial_ring(tmp_path):
+    """The distributed long-context path for real: image height sharded over
+    the 2-way "model" axis, ring attention's ppermute k/v hops and the
+    data-axis gradient psums both running under one 2-OS-process
+    jax.distributed job — the multi-host form of the sequence parallelism
+    the dryrun zoo proves single-process (__graft_entry__.py)."""
+    _run_job(tmp_path, "gspmd", spatial=True)
 
 
 @pytest.mark.skipif(os.environ.get("DCGAN_TPU_FULL_MH") != "1",

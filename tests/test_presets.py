@@ -14,7 +14,8 @@ class TestPresets:
         # (presets.py docstrings).
         assert set(PRESETS) == {
             "celeba64", "lsun64-dp8", "dcgan128", "cifar10-cond", "wgan-gp",
-            "sagan64", "sagan128", "sngan-cifar10", "stylegan64"}
+            "sagan64", "sagan128", "sagan256-lc", "sngan-cifar10",
+            "stylegan64"}
 
     def test_celeba64_is_reference_headline(self):
         cfg = get_preset("celeba64")
@@ -59,6 +60,16 @@ class TestPresets:
         # attention stage sequence length = 64*64 = 4096 tokens
         assert cfg.model.attn_res ** 2 == 4096
         assert cfg.model.spectral_norm == "gd" and cfg.loss == "hinge"
+
+    def test_sagan256_lc_is_flash_only_config(self):
+        cfg = get_preset("sagan256-lc")
+        assert cfg.model.output_size == 256 and cfg.model.attn_res == 128
+        # attention stage sequence length = 128*128 = 16384 tokens — the
+        # scale where dense attention cannot allocate at batch 64 and the
+        # flash kernels are what makes the config trainable (DESIGN.md §8b)
+        assert cfg.model.attn_res ** 2 == 16384
+        assert cfg.model.use_pallas
+        assert cfg.model.spectral_norm == "d" and cfg.loss == "hinge"
 
     def test_sngan_cifar10_recipe(self):
         cfg = get_preset("sngan-cifar10")

@@ -61,7 +61,7 @@ def main() -> None:
         ring_attention,
         ulysses_attention,
     )
-    from dcgan_tpu.ops.pallas_attention import flash_attention
+    from dcgan_tpu.ops.pallas_attention import ATTN_GEN, flash_attention
 
     scale = args.d ** -0.5
     h = args.heads
@@ -151,12 +151,13 @@ def main() -> None:
                 print(json.dumps({"form": name, "seq": S,
                                   "ms": round(ms, 2), "heads": h,
                                   "batch": args.batch,
-                                  "backward": not args.forward_only}))
+                                  "backward": not args.forward_only,
+                                  "gen": ATTN_GEN}))
             except Exception as e:  # the dense wall is the measurement
                 print(json.dumps({"form": name, "seq": S,
                                   "error": f"{type(e).__name__}: "
                                            f"{str(e)[:160]}",
-                                  "heads": h}))
+                                  "heads": h, "gen": ATTN_GEN}))
 
 
 if __name__ == "__main__":

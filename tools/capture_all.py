@@ -112,6 +112,9 @@ STEPS = [
     _bench("dcgan256-attn128-dense-b64", timeout=600, BENCH_SIZE="256",
            BENCH_ATTN_RES="128", BENCH_BATCH="64",
            BENCH_STEPS="40", BENCH_SCAN="5"),
+    # the named long-context preset (hinge + SN-D on top of the raw rows)
+    _bench("sagan256-lc", timeout=900, BENCH_PRESET="sagan256-lc",
+           BENCH_STEPS="40", BENCH_SCAN="5"),
     ("attention", "attn-crossover-small",
      [sys.executable, "tools/bench_attention.py",
       "--seq", "1024", "4096", "16384"], {}, 600, True),
@@ -253,8 +256,13 @@ def _attention_rows(rows):
     # whose dense+flash measurements (which share one tunnel window) have
     # the lowest combined ms — a per-cell best-of would splice forms from
     # different windows and corrupt the dense/flash ratio the table exists
-    # to show. A run with an error row is only selected while no run has a
-    # complete pair for that seq (the dense wall rows stay visible).
+    # to show. Runs compete only within the HIGHEST kernel generation
+    # present for that seq (bench_attention stamps ATTN_GEN into every
+    # row; pre-tag history is gen 0), so measurements of superseded kernel
+    # code never get published as the current kernels' numbers — the same
+    # reason the memory branch keeps latest-only. A run with an error row
+    # is only selected while no run of that generation has a complete pair
+    # (the dense wall rows stay visible).
     pairs = {}   # seq -> {form: row} of the selected run
     for r in rows:
         if r["section"] != "attention":
@@ -272,9 +280,11 @@ def _attention_rows(rows):
                 by_seq.setdefault(p["seq"], {})[p["form"]] = \
                     dict(p, date=r["date"])
         def _score(cand):
+            gen = max(p.get("gen", 0) for p in cand.values())
             oks = [p["ms"] for p in cand.values() if "ms" in p]
-            # complete pairs first (fewer errors), then fastest window
-            return (len(cand) - len(oks), sum(oks))
+            # highest kernel generation first, then complete pairs
+            # (fewer errors), then fastest window
+            return (-gen, len(cand) - len(oks), sum(oks))
         for seq, cand in by_seq.items():
             cur = pairs.get(seq)
             if cur is None or _score(cand) < _score(cur):
