@@ -61,12 +61,20 @@ class ModelConfig:
     kernel_size: int = 5           # conv / deconv kernel (distriubted_model.py:176,190)
     compute_dtype: str = "bfloat16"  # MXU-native compute precision
     param_dtype: str = "float32"     # parameter / BN-stat storage precision
-    use_pallas: bool = False       # fused Pallas BN+act kernels (+ flash
-                                   # attention when attn_res > 0). Capability
-                                   # flag, NOT a perf flag: measured SLOWER
-                                   # at flagship shapes (~20% in-step; XLA's
-                                   # fusion already sits at the HBM roof —
-                                   # DESIGN.md §8b)
+    use_pallas: bool = False       # Pallas kernels: flash attention when
+                                   # attn_res > 0 (a measured WIN at long
+                                   # sequences — DESIGN.md §8b) plus the
+                                   # fused BN+act kernels (capability only:
+                                   # ~20% SLOWER at flagship shapes; XLA's
+                                   # fusion already sits at the HBM roof)
+    bn_pallas: Optional[bool] = None  # override the BN half of use_pallas
+                                   # alone (None = follow use_pallas).
+                                   # Set False by the gspmd backend under a
+                                   # spatial mesh, where flash attention
+                                   # composes (it runs in its own
+                                   # shard_map, ring x flash) but the BN
+                                   # kernels' full-channel-vector contract
+                                   # does not survive height sharding
     attn_res: int = 0              # >0 inserts a SAGAN-style self-attention
                                    # block (ops/attention.py) into both stacks
                                    # at the stage whose feature maps are
@@ -94,11 +102,26 @@ class ModelConfig:
                                    # Power-iteration state is explicit, like
                                    # BN moments (ops/spectral.py)
 
+    @property
+    def bn_use_pallas(self) -> bool:
+        """Whether BatchNorm runs the fused Pallas kernels — use_pallas
+        unless bn_pallas overrides it (model BN call sites read this; the
+        attention sites read use_pallas directly)."""
+        return self.use_pallas if self.bn_pallas is None else self.bn_pallas
+
     def __post_init__(self):
         if self.arch not in ("dcgan", "resnet", "stylegan"):
             raise ValueError(
                 f"arch must be 'dcgan', 'resnet', or 'stylegan', got "
                 f"{self.arch!r}")
+        if self.bn_pallas and not self.use_pallas:
+            # the field only NARROWS use_pallas (the spatial-mesh fallback);
+            # letting it enable the BN kernels alone would route around the
+            # backend's multi-device composition guards (parallel/api.py)
+            raise ValueError(
+                "bn_pallas=True requires use_pallas=True (bn_pallas only "
+                "narrows the flag; to run the fused BN kernels alone use "
+                "use_pallas=True with attn_res=0)")
         if self.arch == "stylegan":
             if self.conditional_bn:
                 raise ValueError(

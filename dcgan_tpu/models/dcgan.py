@@ -223,7 +223,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
     h, new_state["bn0"] = batch_norm_apply(
         params["bn0"], state["bn0"], h, train=train,
         momentum=cfg.bn_momentum, eps=cfg.bn_eps, axis_name=axis_name,
-        act="relu", use_pallas=cfg.use_pallas, labels=bn_labels,
+        act="relu", use_pallas=cfg.bn_use_pallas, labels=bn_labels,
         pallas_mesh=pallas_mesh)
     if cfg.attn_res == cfg.base_size:
         h = attn_apply(attn_params(), h, compute_dtype=cdt,
@@ -239,7 +239,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
             h, new_state[f"bn{i}"] = batch_norm_apply(
                 params[f"bn{i}"], state[f"bn{i}"], h, train=train,
                 momentum=cfg.bn_momentum, eps=cfg.bn_eps,
-                axis_name=axis_name, act="relu", use_pallas=cfg.use_pallas,
+                axis_name=axis_name, act="relu", use_pallas=cfg.bn_use_pallas,
                 labels=bn_labels, pallas_mesh=pallas_mesh)
             if cfg.attn_res == cfg.base_size * (2 ** i):
                 h = attn_apply(attn_params(), h, compute_dtype=cdt,
@@ -362,7 +362,7 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
                 params[f"bn{i}"], state[f"bn{i}"], h, train=train,
                 momentum=cfg.bn_momentum, eps=cfg.bn_eps,
                 axis_name=axis_name, act="lrelu", leak=cfg.leak,
-                use_pallas=cfg.use_pallas, pallas_mesh=pallas_mesh)
+                use_pallas=cfg.bn_use_pallas, pallas_mesh=pallas_mesh)
         else:
             h = lrelu(h, cfg.leak)
         if cfg.attn_res and cfg.attn_res == cfg.output_size >> (i + 1):

@@ -263,21 +263,40 @@ def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
         spec = P(batch_axis, seq_axis, None)
 
     if seq_parallel and seq_strategy == "ulysses":
-        # heads stay unfolded: the all_to_all itself is the head split
+        # heads stay unfolded: the all_to_all itself is the head split.
+        # check_vma only without pallas: pallas_call outputs carry no vma
+        # annotations (same constraint as ops/norm.py / shard_map_backend)
         f = jax.shard_map(
             functools.partial(ulysses_attention, axis_name=seq_axis,
                               n_shards=n, num_heads=num_heads, scale=scale,
                               use_pallas=use_pallas),
-            mesh=seq_mesh, in_specs=(spec, spec, spec), out_specs=spec)
+            mesh=seq_mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=not use_pallas)
         out = f(q, k, v)
     else:
         if num_heads > 1:
             q, k, v = (_split_heads(t, num_heads) for t in (q, k, v))
         if seq_parallel:
+            if use_pallas:
+                # ring x flash: the per-hop fold runs the flash kernels, so
+                # no device ever materializes even its LOCAL
+                # [S_local, S_local] score block — the composition for
+                # sequences whose shards are themselves long
+                # (ops/pallas_attention.py::ring_flash_attention)
+                from dcgan_tpu.ops.pallas_attention import (
+                    ring_flash_attention,
+                )
+
+                ring_fn = functools.partial(
+                    ring_flash_attention, scale=scale, axis_name=seq_axis,
+                    n_shards=n)
+            else:
+                ring_fn = functools.partial(
+                    ring_attention, axis_name=seq_axis, n_shards=n,
+                    scale=scale)
             ring = jax.shard_map(
-                functools.partial(ring_attention, axis_name=seq_axis,
-                                  n_shards=n, scale=scale),
-                mesh=seq_mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                ring_fn, mesh=seq_mesh, in_specs=(spec, spec, spec),
+                out_specs=spec, check_vma=not use_pallas)
             out = ring(q, k, v)
         elif use_pallas:
             from dcgan_tpu.ops.pallas_attention import flash_attention

@@ -30,9 +30,11 @@ Layout notes (TPU):
 Composition: `ops/attention.py::attn_apply(use_pallas=True)` routes its dense
 path here (single chip, or per-shard under the shard_map backend — pallas_call
 is opaque to the GSPMD partitioner, same constraint as ops/pallas_kernels.py).
-Under a spatial mesh the ring path already achieves O(S_local^2) tiles; ring
-hops and flash tiles solve the same problem at two different levels, so they
-are not nested.
+Under a spatial mesh the same flag routes the ring strategy through
+`ring_flash_attention` (bottom of this module): ring hops bound the
+per-device sequence, flash tiles bound the per-hop fold, so neither level
+ever materializes a score matrix — the nesting for sequences whose shards
+are themselves long.
 """
 
 from __future__ import annotations
@@ -248,24 +250,43 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
 
+def _bwd_stats(q, out, lse, g):
+    """The hop-invariant backward inputs, computed once per backward pass
+    (the ring backward reuses them across every hop):
+
+    - delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
+      one fused elementwise reduction, XLA handles it. [B, S, 1] like lse.
+    - do: the f32 cotangent cast to the matmul operand dtype ONCE — under
+      bf16 it halves do's HBM traffic and its full-array VMEM residency in
+      the dkv kernel.
+    - lse_r/delta_r: lane-major packing for the two per-row stats — the
+      dkv kernel holds them full-sequence, and a [S, 1] block lane-pads
+      128x (8 MiB at S=16384 where 64 KiB is the data); [1, S] keeps S on
+      the lane axis.
+    """
+    B, S, _ = q.shape
+    delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1, keepdims=True)
+    do = g.astype(q.dtype)
+    return do, delta, lse.reshape(B, 1, S), delta.reshape(B, 1, S)
+
+
 def _bwd_impl(scale, res, g):
     q, k, v, out, lse = res
+    do, delta, lse_r, delta_r = _bwd_stats(q, out, lse, g)
+    return _bwd_core(scale, q, k, v, do, lse, delta, lse_r, delta_r)
+
+
+def _bwd_core(scale, q, k, v, do, lse, delta, lse_r, delta_r,
+              grad_dtype=None):
+    """The two backward pallas_calls. grad_dtype overrides the gradient
+    output dtype (the ring backward asks for f32 so per-hop contributions
+    are not rounded to bf16 before the cross-hop accumulation)."""
     B, S, dk = q.shape
     dv = v.shape[-1]
     tq, tk = _blocks(S)
-    # delta_i = rowsum(dO_i * O_i) — the softmax-jacobian correction term;
-    # one fused elementwise reduction, XLA handles it. [B, S, 1] like lse.
-    delta = jnp.sum(g.astype(jnp.float32) * out, axis=-1,
-                    keepdims=True)
-    # cast the f32 cotangent to the matmul operand dtype ONCE, outside the
-    # kernels — under bf16 it halves do's HBM traffic and its full-array
-    # VMEM residency in the dkv kernel
-    do = g.astype(q.dtype)
-    # lane-major packing for the two per-row stats: the dkv kernel holds
-    # them full-sequence, and a [S, 1] block lane-pads 128x (8 MiB at
-    # S=16384 where 64 KiB is the data) — [1, S] keeps S on the lane axis
-    lse_r = lse.reshape(B, 1, S)
-    delta_r = delta.reshape(B, 1, S)
+    dq_dt = grad_dtype or q.dtype
+    dk_dt = grad_dtype or k.dtype
+    dv_dt = grad_dtype or v.dtype
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, tk=tk),
@@ -277,7 +298,7 @@ def _bwd_impl(scale, res, g):
                   pl.BlockSpec((1, tq, 1), lambda b, i: (b, i, 0)),
                   pl.BlockSpec((1, tq, 1), lambda b, i: (b, i, 0))],
         out_specs=pl.BlockSpec((1, tq, dk), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, S, dk), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, S, dk), dq_dt),
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
@@ -293,12 +314,12 @@ def _bwd_impl(scale, res, g):
                   pl.BlockSpec((1, 1, S), lambda b, j: (b, 0, 0))],
         out_specs=(pl.BlockSpec((1, tk, dk), lambda b, j: (b, j, 0)),
                    pl.BlockSpec((1, tk, dv), lambda b, j: (b, j, 0))),
-        out_shape=(jax.ShapeDtypeStruct((B, S, dk), k.dtype),
-                   jax.ShapeDtypeStruct((B, S, dv), v.dtype)),
+        out_shape=(jax.ShapeDtypeStruct((B, S, dk), dk_dt),
+                   jax.ShapeDtypeStruct((B, S, dv), dv_dt)),
         compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(q, k, v, do, lse_r, delta_r)
-    return dq.astype(q.dtype), dk_arr, dv_arr
+    return dq, dk_arr, dv_arr
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -317,3 +338,104 @@ def _flash_vjp_fwd(q, k, v, scale):
 
 
 flash_attention.defvjp(_flash_vjp_fwd, _bwd_impl)
+
+
+# ---------------------------------------------------------------------------
+# ring x flash composition: sequence-parallel attention whose per-hop fold
+# runs the flash kernels — for the regime where each device's S_local block
+# itself outgrows what a dense [S_local, S_local] fold should materialize.
+# ---------------------------------------------------------------------------
+
+def ring_flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                         scale: float, axis_name: str,
+                         n_shards: int) -> jax.Array:
+    """Exact attention over a sequence sharded along `axis_name`, with every
+    per-block fold running the flash kernels instead of a dense
+    [S_local, S_local] einsum.
+
+    Same contract as ops/attention.py::ring_attention (q/k/v [B, S_local, d]
+    per device, n_shards-1 ppermute hops, f32 result), but the hop fold is
+    `_fwd_impl` — each block contributes a normalized partial (out_b, lse_b)
+    and partials merge associatively: lse = logaddexp(lse_a, lse_b),
+    out = out_a*exp(lse_a-lse) + out_b*exp(lse_b-lse). The backward
+    re-rotates (k, v) around the ring and reuses `_bwd_impl` per hop with
+    the GLOBAL lse (p = exp(s - lse_global) gives each block's true global
+    probabilities), accumulating dq locally while (dk, dv) ride the ring
+    with their blocks and land home after the full cycle.
+    """
+    if n_shards == 1:
+        return flash_attention(q, k, v, scale)
+    return _ring_flash(q, k, v, scale, axis_name, n_shards)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, scale, axis_name, n_shards):
+    out, _ = _ring_flash_fwd_pass(q, k, v, scale, axis_name, n_shards)
+    return out
+
+
+def _ring_flash_fwd_pass(q, k, v, scale, axis_name, n_shards):
+    fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+    # resident block first (no hop result is discarded), then n-1 rotations
+    out, lse = _fwd_impl(q, k, v, scale)
+
+    def hop(carry, _):
+        k_blk, v_blk, out, lse = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm=fwd)
+        v_blk = lax.ppermute(v_blk, axis_name, perm=fwd)
+        out_b, lse_b = _fwd_impl(q, k_blk, v_blk, scale)
+        lse_new = jnp.logaddexp(lse, lse_b)
+        out = (out * jnp.exp(lse - lse_new)
+               + out_b * jnp.exp(lse_b - lse_new))
+        return (k_blk, v_blk, out, lse_new), None
+
+    (_, _, out, lse), _ = lax.scan(
+        hop, (k, v, out, lse), None, length=n_shards - 1)
+    return out, lse
+
+
+def _ring_flash_vjp_fwd(q, k, v, scale, axis_name, n_shards):
+    out, lse = _ring_flash_fwd_pass(q, k, v, scale, axis_name, n_shards)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_vjp_bwd(scale, axis_name, n_shards, res, g):
+    q, k, v, out, lse = res
+    fwd = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    # hop-invariant backward inputs computed ONCE (delta, the operand-dtype
+    # cotangent, and the lane-major stat packings) — only the two pallas
+    # kernels re-run per hop
+    do, delta, lse_r, delta_r = _bwd_stats(q, out, lse, g)
+
+    def hop(carry, _):
+        # (k, v) and their accumulated gradients travel TOGETHER: each
+        # device adds its contribution to the passing block, and after the
+        # full n_shards-rotation cycle every (dk, dv) sits on the block's
+        # home device, complete. dq accumulates locally. Per-hop gradient
+        # terms come out of the kernels ALREADY f32 (grad_dtype) so the
+        # cross-hop accumulation never rounds through bf16.
+        k_blk, v_blk, dk_c, dv_c, dq = carry
+        dq_h, dk_h, dv_h = _bwd_core(
+            scale, q, k_blk, v_blk, do, lse, delta, lse_r, delta_r,
+            grad_dtype=jnp.float32)
+        dq = dq + dq_h
+        dk_c = dk_c + dk_h
+        dv_c = dv_c + dv_h
+        k_blk = lax.ppermute(k_blk, axis_name, perm=fwd)
+        v_blk = lax.ppermute(v_blk, axis_name, perm=fwd)
+        dk_c = lax.ppermute(dk_c, axis_name, perm=fwd)
+        dv_c = lax.ppermute(dv_c, axis_name, perm=fwd)
+        return (k_blk, v_blk, dk_c, dv_c, dq), None
+
+    zeros = (jnp.zeros(k.shape, jnp.float32),
+             jnp.zeros(v.shape, jnp.float32))
+    (_, _, dk_c, dv_c, dq), _ = lax.scan(
+        hop, (k, v) + zeros + (jnp.zeros(q.shape, jnp.float32),),
+        None, length=n_shards)
+    # after n rotations the blocks (and their grads) are home again
+    return (dq.astype(q.dtype), dk_c.astype(k.dtype),
+            dv_c.astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)

@@ -92,21 +92,30 @@ def make_parallel_train(cfg: TrainConfig,
         # per data-shard inside a shard_map nested in this jit (the ring-
         # attention pattern; ops/norm.py::_pallas_shard_moments) — VERDICT
         # r1 #5. Model/spatial sharding (channel- or height-sharded
-        # activations break the kernels' full-channel-vector contract) and
-        # the flash-attention kernels stay out of scope: reject those.
+        # activations break the kernels' full-channel-vector contract)
+        # stays rejected — EXCEPT the spatial + attention case, where the
+        # attention already runs in its own explicit shard_map and the
+        # flash kernels compose as ring x flash
+        # (ops/pallas_attention.py::ring_flash_attention): there, only the
+        # BN half of the flag falls back to the jnp path.
         if mesh.shape["model"] > 1 or cfg.mesh.spatial:
-            raise ValueError(
-                "use_pallas under the gspmd backend composes with data-"
-                f"parallel meshes only, got mesh={dict(mesh.shape)} "
-                f"(spatial={cfg.mesh.spatial}); the fused kernels need "
-                "full channel vectors per shard")
-        if cfg.model.attn_res:
+            if cfg.mesh.spatial and cfg.model.attn_res:
+                cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+                    cfg.model, bn_pallas=False))
+            else:
+                raise ValueError(
+                    "use_pallas under the gspmd backend composes with data-"
+                    f"parallel meshes only, got mesh={dict(mesh.shape)} "
+                    f"(spatial={cfg.mesh.spatial}); the fused kernels need "
+                    "full channel vectors per shard")
+        elif cfg.model.attn_res:
             raise ValueError(
                 "use_pallas + attn_res on a multi-device gspmd mesh is not "
                 "supported (the flash-attention pallas_call is opaque to "
-                "the partitioner); use backend='shard_map' or drop one "
-                "flag")
-        pallas_mesh = mesh
+                "the partitioner); use backend='shard_map', --mesh_spatial "
+                "(ring x flash), or drop one flag")
+        else:
+            pallas_mesh = mesh
     spatial = cfg.mesh.spatial
     img_sh = batch_sharding(mesh, 4, spatial=spatial)
     constrain_fake = None

@@ -131,6 +131,12 @@ def sagan256_lc(**overrides) -> TrainConfig:
     cfg = _build(ModelConfig(output_size=256, attn_res=128,
                              spectral_norm="d", use_pallas=True),
                  MeshConfig(),
+                 # shard_map backend: use_pallas + attn_res composes with
+                 # data-parallel meshes at ANY device count there (each
+                 # shard runs the kernels locally; the gspmd partitioner
+                 # would reject the combination on a multi-device mesh —
+                 # parallel/api.py)
+                 backend="shard_map",
                  batch_size=64, loss="hinge", beta1=0.0,
                  d_learning_rate=4e-4, g_learning_rate=1e-4,
                  g_ema_decay=0.999)

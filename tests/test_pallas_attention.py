@@ -13,7 +13,12 @@ import pytest
 pytestmark = pytest.mark.slow  # see pytest.ini: excluded from the smoke tier
 
 from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
-from dcgan_tpu.ops.attention import attn_apply, attn_init, full_attention
+from dcgan_tpu.ops.attention import (
+    attn_apply,
+    attn_init,
+    full_attention,
+    ring_attention,
+)
 from dcgan_tpu.ops.pallas_attention import flash_attention
 from dcgan_tpu.train import make_train_step
 
@@ -92,6 +97,92 @@ class TestFlashAttention:
             flash_attention(q, k, v, scale) ** 2))(q)
         np.testing.assert_allclose(np.asarray(g_fl), np.asarray(g_ref),
                                    atol=2e-5)
+
+
+class TestRingFlash:
+    """ring x flash composition (ops/pallas_attention.py::
+    ring_flash_attention): sequence-parallel ring hops whose per-block fold
+    runs the flash kernels — exactness vs full attention and vs the dense
+    ring, forward and gradients, on the 8-virtual-device mesh."""
+
+    def _mesh_and_spec(self, n):
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(np.asarray(jax.devices()[:n]).reshape(1, n),
+                    ("data", "model"))
+        return mesh, P("data", "model", None)
+
+    def _smap(self, fn, n):
+        mesh, spec = self._mesh_and_spec(n)
+        # check_vma=False: pallas_call outputs carry no vma annotations
+        # (same constraint as attn_apply's seq-parallel pallas routing)
+        return jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec, check_vma=False)
+
+    def test_forward_matches_dense_and_ring(self):
+        import functools
+
+        from dcgan_tpu.ops.pallas_attention import ring_flash_attention
+
+        q, k, v = qkv(S=256, d=16, dv=32)
+        scale = q.shape[-1] ** -0.5
+        n = 8
+        rf = self._smap(functools.partial(
+            ring_flash_attention, scale=scale, axis_name="model",
+            n_shards=n), n)
+        ring = self._smap(functools.partial(
+            ring_attention, axis_name="model", n_shards=n, scale=scale), n)
+        dense = full_attention(q, k, v, scale=scale)
+        np.testing.assert_allclose(np.asarray(rf(q, k, v)),
+                                   np.asarray(dense), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(rf(q, k, v)),
+                                   np.asarray(ring(q, k, v)), atol=2e-5)
+
+    def test_gradients_match_dense(self):
+        import functools
+
+        from dcgan_tpu.ops.pallas_attention import ring_flash_attention
+
+        q, k, v = qkv(S=128, d=8, dv=16)
+        scale = q.shape[-1] ** -0.5
+        n = 4
+        rf = self._smap(functools.partial(
+            ring_flash_attention, scale=scale, axis_name="model",
+            n_shards=n), n)
+
+        g_rf = jax.grad(lambda q, k, v: jnp.sum(rf(q, k, v) ** 2),
+                        argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(
+            lambda q, k, v: jnp.sum(
+                full_attention(q, k, v, scale=scale) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_rf):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=5e-5)
+
+    def test_single_shard_is_flash(self):
+        from dcgan_tpu.ops.pallas_attention import ring_flash_attention
+
+        q, k, v = qkv(S=128)
+        scale = q.shape[-1] ** -0.5
+        out = ring_flash_attention(q, k, v, scale=scale, axis_name="model",
+                                   n_shards=1)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.asarray(full_attention(q, k, v, scale=scale)), atol=2e-6)
+
+    def test_attn_apply_routes_ring_through_flash(self):
+        mesh, _ = self._mesh_and_spec(8)
+        params = attn_init(jax.random.key(0), 16)
+        params = dict(params, gamma=jnp.asarray(0.7))
+        x = jax.random.normal(jax.random.key(1), (2, 16, 16, 16))
+        dense_ring = attn_apply(params, x, seq_mesh=mesh,
+                                seq_strategy="ring")
+        flash_ring = attn_apply(params, x, seq_mesh=mesh,
+                                seq_strategy="ring", use_pallas=True)
+        np.testing.assert_allclose(np.asarray(flash_ring),
+                                   np.asarray(dense_ring), atol=1e-5)
 
 
 class TestFusedAttnApply:

@@ -69,6 +69,10 @@ class TestPresets:
         # flash kernels are what makes the config trainable (DESIGN.md §8b)
         assert cfg.model.attn_res ** 2 == 16384
         assert cfg.model.use_pallas
+        # shard_map backend: the one backend where use_pallas + attn_res
+        # composes on multi-device data-parallel meshes (parallel/api.py
+        # rejects the pair under multi-device gspmd)
+        assert cfg.backend == "shard_map"
         assert cfg.model.spectral_norm == "d" and cfg.loss == "hinge"
 
     def test_sngan_cifar10_recipe(self):
