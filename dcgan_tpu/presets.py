@@ -23,6 +23,12 @@ Plus one beyond-BASELINE family:
 - ``sagan64``     — self-attention GAN (hinge + TTUR + EMA, attention at
   32x32), whose attention block is the framework's sequence-parallel
   (ring-attention) showcase under ``--mesh_spatial``.
+- ``sagan128``    — the same recipe with attention at 64x64 (4096 tokens).
+- ``sagan256-lc`` — the long-context configuration: attention over a
+  128x128 feature map (16384 tokens) on the flash kernels, where the
+  dense form cannot allocate at batch 64 (DESIGN.md §8b).
+- ``sngan-cifar10`` / ``stylegan64`` — the resnet and stylegan families'
+  canonical recipes (see their factory docstrings).
 
 Every preset factory takes overrides as keyword arguments forwarded to
 `dataclasses.replace`-style reconstruction, so the CLI's explicit flags win
