@@ -133,6 +133,56 @@ def shard_for_process(paths: Sequence[str], process_index: int,
     return mine or list(paths)
 
 
+def process_local_box(sharding, global_shape, *, devices=None):
+    """Bounding box (tuple of index slices) of THIS process's addressable
+    shards of a global array under `sharding`.
+
+    `devices`: override the "addressable" set (default: devices whose
+    process_index is this process's) — lets single-process tests exercise
+    the multi-process geometry.
+
+    `make_array_from_process_local_data` requires local data shaped like the
+    process-local portion of the global array — which is "batch/process_count
+    x everything else" ONLY when each process's devices cover whole rows of
+    every non-batch sharded axis. Under a spatial mesh whose "model" axis
+    spans processes (e.g. 4 processes x 2 devices with a 4-way height axis),
+    a process owns a batch-slice x height-slice BLOCK instead; feeding it
+    the naive per-process batch silently mis-assembles the global array
+    (observed as a doubled height dim at trace time). This helper computes
+    the true block from the sharding itself, so data sources can produce
+    exactly the addressable portion for ANY (data, model) layout.
+    """
+    import jax
+
+    idx_map = sharding.devices_indices_map(tuple(global_shape))
+    if devices is not None:
+        owned = set(devices)
+        mine = [idx for d, idx in idx_map.items() if d in owned]
+    else:
+        mine = [idx for d, idx in idx_map.items()
+                if d.process_index == jax.process_index()]
+    if not mine:  # no addressable shard (shouldn't happen in practice)
+        raise ValueError("sharding has no addressable shards here")
+    ndim = len(global_shape)
+    lo = [min(s.indices(global_shape[a])[0] for s in (idx[a] for idx in mine))
+          for a in range(ndim)]
+    hi = [max(s.indices(global_shape[a])[1] for s in (idx[a] for idx in mine))
+          for a in range(ndim)]
+    # the union of this process's shards must tile the bounding box exactly
+    # (true for any mesh-aligned NamedSharding; guards pathological cases)
+    distinct = {tuple((s.indices(global_shape[a])[:2])
+                      for a, s in enumerate(idx)) for idx in mine}
+    box_vol = 1
+    for a in range(ndim):
+        box_vol *= hi[a] - lo[a]
+    tiled = sum(
+        int(np.prod([e - b for b, e in idx])) for idx in distinct)
+    if tiled != box_vol:
+        raise ValueError(
+            f"process-local shards do not tile a box: {sorted(distinct)}")
+    return tuple(slice(lo[a], hi[a]) for a in range(ndim))
+
+
 # ---------------------------------------------------------------------------
 # Pure-Python loader (fallback / reference implementation for tests)
 # ---------------------------------------------------------------------------

@@ -59,22 +59,71 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
     label_sharding = batch_sharding(mesh, 1) if conditional else None
     if synthetic:
         def it():
-            per_proc = cfg.batch_size // jax.process_count()
+            # to_global needs this process's ADDRESSABLE BLOCK of the
+            # global batch (pipeline.process_local_box). The naive
+            # per-process slice (batch/process_count x full height) is that
+            # block only while each process's devices cover whole mesh
+            # rows; under a spatial mesh whose "model" axis spans
+            # processes, the block is a batch-slice x height-slice instead
+            # — and processes sharing a batch row MUST contribute
+            # height-slices of the SAME images. Seeding the stream by the
+            # block's BATCH OFFSET (not the process index) guarantees
+            # that: co-row processes draw identical full-height images and
+            # cut different height slices, while batch-disjoint processes
+            # draw distinct streams at 1/P of the global host cost.
+            # Single-process keeps the exact previous stream (offset 0,
+            # full box).
+            from dcgan_tpu.data.pipeline import process_local_box
+
+            size = cfg.model.output_size
+            box = process_local_box(
+                sharding, (cfg.batch_size, size, size, cfg.model.c_dim))
+            n_local = box[0].stop - box[0].start
             src = synthetic_batches(
-                per_proc, cfg.model.output_size, cfg.model.c_dim,
-                seed=cfg.seed + seed_offset + jax.process_index(),
+                n_local, size, cfg.model.c_dim,
+                seed=cfg.seed + seed_offset + box[0].start,
                 num_classes=cfg.model.num_classes)
+            hwc = (box[1], box[2], box[3])
+
+            def cut(batch):
+                if isinstance(batch, tuple):
+                    return batch[0][(slice(None),) + hwc], batch[1]
+                return batch[(slice(None),) + hwc]
+
             if cfg.synthetic_device_cache > 0:
                 # pre-staged device pool, cycled forever: the loop consumes
                 # already-resident sharded arrays, so measurements see the
                 # trainer machinery, not the host->device transport
-                pool = [to_global(next(src), sharding, label_sharding)
+                pool = [to_global(cut(next(src)), sharding, label_sharding)
                         for _ in range(cfg.synthetic_device_cache)]
                 while True:
                     yield from pool
             for batch in src:
-                yield to_global(batch, sharding, label_sharding)
+                yield to_global(cut(batch), sharding, label_sharding)
         return it()
+    if jax.process_count() > 1:
+        # The file-shard ownership model (process i owns shards i, i+P, ...)
+        # assumes batch-disjoint processes. A spatial mesh whose "model"
+        # (height) axis spans processes makes two processes co-own one batch
+        # row — they would need to assemble height-slices of the SAME
+        # images, which a threaded shuffle loader cannot reproduce
+        # deterministically across processes. The synthetic path supports
+        # such layouts (common-seed global batch, sliced per process);
+        # real data requires the model axis to fit within each process's
+        # devices (height sharding then happens on-device, not at load).
+        from dcgan_tpu.data.pipeline import process_local_box
+
+        size = cfg.model.output_size
+        box = process_local_box(
+            sharding, (cfg.batch_size, size, size, cfg.model.c_dim))
+        full = (size, size, cfg.model.c_dim)
+        if any(b.stop - b.start != g for b, g in zip(box[1:], full)):
+            raise ValueError(
+                "real-data loading requires each process's devices to cover "
+                "full images (the spatial 'model' axis must not span "
+                f"processes; this process's block is {box}). Lay the mesh "
+                "out with model <= local_device_count, or use synthetic "
+                "data for cross-process height-sharding experiments.")
     the_dir = data_dir if data_dir is not None else cfg.data_dir
     # The dataset.json manifest's wire format is authoritative — the same
     # policy evals/__main__.py applies (no flag there at all). The

@@ -10,9 +10,11 @@ mesh, chief-gated metrics + sample grid, collective final checkpoint.
 import os
 import sys
 
+_LOCAL = int(os.environ.get("MH_LOCAL_DEVICES", "4"))
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                           + " --xla_force_host_platform_device_count=4")
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={_LOCAL}")
 
 import jax  # noqa: E402
 
@@ -30,8 +32,8 @@ def main() -> None:
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=nproc, process_id=pid)
     assert jax.process_count() == nproc, jax.process_count()
-    assert jax.local_device_count() == 4, jax.local_device_count()
-    assert jax.device_count() == 4 * nproc, jax.device_count()
+    assert jax.local_device_count() == _LOCAL, jax.local_device_count()
+    assert jax.device_count() == _LOCAL * nproc, jax.device_count()
 
     from dcgan_tpu.config import ModelConfig, TrainConfig
     from dcgan_tpu.train.trainer import train
@@ -40,18 +42,32 @@ def main() -> None:
     # MH_SPC > 1: the scanned multi-step dispatch (steps_per_call) under a
     # real 2-process job — cadences must be multiples of the call size
     spc = int(os.environ.get("MH_SPC", "1"))
-    # MH_SPATIAL=1: the distributed long-context path — image height
-    # sharded over a 2-way "model" axis with ring attention (ppermute k/v
+    # MH_SPATIAL=N (N>1): the distributed long-context path — image height
+    # sharded over an N-way "model" axis with ring attention (ppermute k/v
     # around the sequence axis) running under the SAME jax.distributed job
-    # that carries the data-parallel gradient psums over localhost DCN
-    spatial = os.environ.get("MH_SPATIAL") == "1"
+    # that carries the data-parallel gradient psums over localhost DCN.
+    # N > 2 makes the ring MULTI-hop: with the model axis laid out across
+    # processes, at least one k/v rotation (and, under MH_PALLAS, one
+    # homeward (dk, dv) rotation of the flash backward) crosses a real
+    # process boundary per scan iteration (VERDICT r4 #3b).
+    spatial = int(os.environ.get("MH_SPATIAL", "0") or "0")
+    if spatial == 1:
+        # backward compat: MH_SPATIAL used to be a boolean flag whose "1"
+        # meant the 2-way spatial mesh; a 1-way spatial axis is invalid
+        # (MeshConfig rejects it), so keep the old meaning
+        spatial = 2
+    # MH_PALLAS=1: ring x flash — each hop's fold runs the flash kernels
+    # (interpret mode on CPU devices), and the backward is the custom
+    # grad-homing vjp (ops/pallas_attention.py::_ring_flash_vjp_bwd)
+    use_pallas = os.environ.get("MH_PALLAS") == "1"
     from dcgan_tpu.config import MeshConfig
 
     cfg = TrainConfig(
         model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
                           compute_dtype="float32",
-                          attn_res=8 if spatial else 0),
-        mesh=(MeshConfig(model=2, spatial=True) if spatial
+                          attn_res=8 if spatial else 0,
+                          use_pallas=use_pallas),
+        mesh=(MeshConfig(model=spatial, spatial=True) if spatial
               else MeshConfig()),
         batch_size=16,                       # global; 8 per process
         backend=backend,

