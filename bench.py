@@ -82,7 +82,7 @@ def _bench_sample(cfg, pt, state, n_chips: int) -> None:
     arch = os.environ.get("BENCH_PRESET", "") or (
         f"SAGAN-{cfg.model.output_size}" if cfg.model.attn_res
         else f"DCGAN-{cfg.model.output_size}")
-    print(json.dumps({
+    row = {
         "metric": f"{arch} sampler (inference) throughput "
                   f"(batch {batch // n_chips}/chip, bf16)",
         "value": round(img_per_sec_chip, 1),
@@ -90,7 +90,12 @@ def _bench_sample(cfg, pt, state, n_chips: int) -> None:
         # vs the same adopted train baseline is meaningless for inference;
         # report the ratio to our own measured train rate out-of-band (docs)
         "vs_baseline": None,
-    }))
+    }
+    if cfg.model.attn_res:
+        # same generation stamp as the train rows (VERDICT r4 #1)
+        from dcgan_tpu.ops.pallas_attention import ATTN_GEN
+        row["gen"] = ATTN_GEN
+    print(json.dumps(row))
     print(f"chips={n_chips} batch={batch} calls={n_calls} wall={dt:.2f}s "
           f"ms_per_step={dt / n_calls * 1e3:.2f}", file=sys.stderr)
 
@@ -104,7 +109,7 @@ def main() -> None:
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
     import jax.numpy as jnp
 
-    from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+    from dcgan_tpu.config import MeshConfig, TrainConfig
     from dcgan_tpu.parallel import make_mesh, make_parallel_train
     from dcgan_tpu.utils.backend import acquire_devices
 
@@ -120,26 +125,25 @@ def main() -> None:
 
         from dcgan_tpu.presets import get_preset
 
+        base = get_preset(preset_name)
         cfg = dataclasses.replace(
-            get_preset(preset_name),
+            base,
             batch_size=BATCH * n_chips,
             mesh=MeshConfig(),
             grad_accum=int(os.environ.get("BENCH_ACCUM", 1)),
-            backend=os.environ.get("BENCH_BACKEND", "gspmd"))
+            # only an EXPLICIT BENCH_BACKEND overrides the preset's own
+            # backend — clobbering it would measure a config that isn't
+            # the preset (and stamp the preset's rev onto it)
+            backend=os.environ.get("BENCH_BACKEND", base.backend))
     else:
+        # the BENCH_* model knobs (shared with tools/step_profile.py so a
+        # profile always decomposes exactly a benched config):
+        # dcgan_tpu/utils/bench_env.py documents each
+        from dcgan_tpu.utils.bench_env import bench_model_config
+
+        mcfg, _ = bench_model_config()
         cfg = TrainConfig(
-            model=ModelConfig(          # 64x64, gf=df=64, bf16 compute
-                # BENCH_SIZE: output resolution (default 64; 256 is the
-                # long-context config — attention at 128x128 = S 16384)
-                output_size=int(os.environ.get("BENCH_SIZE", 64)),
-                use_pallas=os.environ.get("BENCH_PALLAS", "") == "1",
-                # BENCH_ATTN=1: the sagan64 architecture (self-attention at
-                # 32x32); with BENCH_PALLAS=1 the block runs the flash
-                # kernels. BENCH_SN=1 adds spectral norm on both nets (the
-                # full sagan64 recipe's Lipschitz control)
-                attn_res=32 if os.environ.get("BENCH_ATTN", "") == "1" else 0,
-                spectral_norm="gd" if os.environ.get("BENCH_SN", "") == "1"
-                else "none"),
+            model=mcfg,                 # flagship default: 64x64, gf=df=64
             batch_size=BATCH * n_chips,
             mesh=MeshConfig(),
             # BENCH_ACCUM=K: gradient-accumulation cost — same global batch,
@@ -147,21 +151,14 @@ def main() -> None:
             # other BENCH_* model knobs rather than forking its own config.
             grad_accum=int(os.environ.get("BENCH_ACCUM", 1)),
             backend=os.environ.get("BENCH_BACKEND", "gspmd"))
-    if os.environ.get("BENCH_ATTN_RES"):
-        # BENCH_ATTN_RES=R: self-attention at an arbitrary feature-map
-        # resolution (sequence length R*R) on top of WHATEVER config was
-        # built above — preset or default. This is the long-context bench
-        # knob: at R=128 (S=16384) the dense [S, S] form cannot allocate at
-        # train batch sizes and only the flash path runs (DESIGN.md §8).
-        import dataclasses
+    # BENCH_ATTN_RES=R: self-attention at an arbitrary feature-map
+    # resolution (sequence length R*R) on top of WHATEVER config was built
+    # above — preset or default. This is the long-context bench knob: at
+    # R=128 (S=16384) the dense [S, S] form cannot allocate at train batch
+    # sizes and only the flash path runs (DESIGN.md §8).
+    from dcgan_tpu.utils.bench_env import apply_attn_res_override
 
-        model_kw = {"attn_res": int(os.environ["BENCH_ATTN_RES"])}
-        if "BENCH_PALLAS" in os.environ:
-            # only override when explicitly set — a preset's own use_pallas
-            # must survive an attn_res-only override
-            model_kw["use_pallas"] = os.environ["BENCH_PALLAS"] == "1"
-        cfg = dataclasses.replace(
-            cfg, model=dataclasses.replace(cfg.model, **model_kw))
+    cfg = apply_attn_res_override(cfg)
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
 
@@ -234,12 +231,30 @@ def main() -> None:
                 else f"DCGAN-{cfg.model.output_size}")
         if cfg.grad_accum > 1:
             arch += f" grad_accum={cfg.grad_accum}"
-    print(json.dumps({
+    row = {
         "metric": f"{arch} train throughput (batch {BATCH}/chip, bf16)",
         "value": round(img_per_sec_chip, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec_chip / V100_TF_BASELINE_IMG_PER_SEC, 3),
-    }))
+    }
+    if cfg.model.attn_res:
+        # Attention-bearing configs stamp the generation of the attention
+        # code they actually EXECUTE — flash kernels or the dense path —
+        # so harvest renders never mix measurements of superseded attention
+        # code into one spread column (VERDICT r4 #1), and a flash-only
+        # generation bump never retires dense-config history.
+        if cfg.model.use_pallas:
+            from dcgan_tpu.ops.pallas_attention import ATTN_GEN
+            row["gen"] = ATTN_GEN
+        else:
+            from dcgan_tpu.ops.attention import DENSE_ATTN_GEN
+            row["gen"] = DENSE_ATTN_GEN
+    if preset_name:
+        # preset rows additionally stamp the preset revision (presets.py:
+        # PRESET_REVS) — same never-mix-configs contract for preset changes
+        from dcgan_tpu.presets import PRESET_REVS
+        row["rev"] = PRESET_REVS.get(preset_name, 1)
+    print(json.dumps(row))
     # context to stderr so the stdout contract stays one JSON line
     print(f"chips={n_chips} global_batch={cfg.batch_size} "
           f"steps={steps_window} scan={SCAN} wall={dt:.2f}s "

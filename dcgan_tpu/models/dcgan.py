@@ -229,7 +229,8 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
         h = attn_apply(attn_params(), h, compute_dtype=cdt,
                        num_heads=cfg.attn_heads,
                        seq_strategy=cfg.attn_seq_strategy,
-                       seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
+                       seq_mesh=attn_mesh, use_pallas=cfg.use_pallas,
+                       pallas_mesh=pallas_mesh)
     if capture is not None:
         capture["h0"] = h
 
@@ -246,7 +247,8 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
                                num_heads=cfg.attn_heads,
                                seq_strategy=cfg.attn_seq_strategy,
                                seq_mesh=attn_mesh,
-                               use_pallas=cfg.use_pallas)
+                               use_pallas=cfg.use_pallas,
+                               pallas_mesh=pallas_mesh)
             if capture is not None:
                 capture[f"h{i}"] = h
 
@@ -369,7 +371,8 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
             h = attn_apply(attn_params(), h, compute_dtype=cdt,
                            num_heads=cfg.attn_heads,
                            seq_strategy=cfg.attn_seq_strategy,
-                           seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
+                           seq_mesh=attn_mesh, use_pallas=cfg.use_pallas,
+                           pallas_mesh=pallas_mesh)
         if capture is not None:
             capture[f"h{i}"] = h
 

@@ -172,7 +172,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
     h = h.reshape(-1, cfg.base_size, cfg.base_size, chans[0])
     if cfg.attn_res == cfg.base_size:
         h = _attn(cfg, params, state, new_state, h, cdt, attn_mesh, sn,
-                  train)
+                  train, pallas_mesh=pallas_mesh)
     if capture is not None:
         capture["h0"] = h
 
@@ -191,7 +191,7 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
         h = r + s
         if cfg.attn_res == cfg.base_size * (2 ** i) and i < k:
             h = _attn(cfg, params, state, new_state, h, cdt, attn_mesh, sn,
-                      train)
+                      train, pallas_mesh=pallas_mesh)
         if capture is not None:
             capture[f"h{i}"] = h
 
@@ -203,14 +203,16 @@ def generator_apply(params: Pytree, state: Pytree, z: jax.Array, *,
     return out, new_state
 
 
-def _attn(cfg, params, state, new_state, h, cdt, attn_mesh, sn, train):
+def _attn(cfg, params, state, new_state, h, cdt, attn_mesh, sn, train,
+          pallas_mesh=None):
     from dcgan_tpu.models.dcgan import _sn_attn
 
     p = _sn_attn(params["attn"], state, new_state, train) if sn \
         else params["attn"]
     return attn_apply(p, h, compute_dtype=cdt, num_heads=cfg.attn_heads,
                       seq_strategy=cfg.attn_seq_strategy,
-                      seq_mesh=attn_mesh, use_pallas=cfg.use_pallas)
+                      seq_mesh=attn_mesh, use_pallas=cfg.use_pallas,
+                      pallas_mesh=pallas_mesh)
 
 
 # ---------------------------------------------------------------------------
@@ -300,7 +302,7 @@ def discriminator_apply(params: Pytree, state: Pytree, image: jax.Array, *,
         h = r + s
         if cfg.attn_res and cfg.attn_res == cfg.output_size >> (i + 1):
             h = _attn(cfg, params, state, new_state, h, cdt, attn_mesh, sn,
-                      train)
+                      train, pallas_mesh=pallas_mesh)
         if capture is not None:
             capture[f"h{i}"] = h
 

@@ -53,6 +53,15 @@ from dcgan_tpu.ops.layers import linear_apply, linear_init
 
 Pytree = dict
 
+# Measurement generation of the DENSE attention path (full_attention and
+# the ring fold below) — the counterpart of pallas_attention.ATTN_GEN for
+# configs that never execute the flash kernels. bench.py stamps whichever
+# generation matches the config's execution form, so a flash-only change
+# (tile retune, block layout) never retires the capture history of dense
+# configs whose code is byte-identical. Gen 2 = the shared bf16-operand /
+# f32-accumulation precision policy (it changed BOTH forms).
+DENSE_ATTN_GEN = 2
+
 
 def attn_init(key, ch: int, *, dtype=jnp.float32) -> Pytree:
     """Parameters for one self-attention block over `ch`-channel feature maps.
@@ -211,8 +220,15 @@ def _project(params: Pytree, x: jax.Array, cdt) -> Tuple[jax.Array, ...]:
 def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
                num_heads: int = 1, seq_mesh=None, seq_axis: str = "model",
                batch_axis: str = "data", seq_strategy: str = "ring",
-               use_pallas: bool = False) -> jax.Array:
+               use_pallas: bool = False, pallas_mesh=None) -> jax.Array:
     """x [B,H,W,C] -> x + gamma * attention(x) (same shape/dtype).
+
+    pallas_mesh: a pure data-parallel Mesh the CALLER's jit partitions
+    over. pallas_call is opaque to the GSPMD partitioner, so on such a
+    mesh the flash path runs per data-shard inside a nested shard_map
+    (the ops/norm.py::_pallas_shard_moments pattern) — attention is
+    batch-local, so the wrapper needs no collectives. Ignored unless
+    use_pallas is set and no sequence mesh applies.
 
     num_heads > 1 splits the existing query/key/value projections into heads
     (folded into the batch dim around the attention proper, so every
@@ -301,7 +317,23 @@ def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
         elif use_pallas:
             from dcgan_tpu.ops.pallas_attention import flash_attention
 
-            out = flash_attention(q, k, v, scale)
+            if pallas_mesh is not None and \
+                    pallas_mesh.shape.get(batch_axis, 1) > 1:
+                # data-parallel gspmd mesh: run the kernels per batch
+                # shard inside a nested shard_map (heads ride the batch
+                # dim batch-major, so a data-axis split keeps whole
+                # batches' head groups together). check_vma off: pallas
+                # outputs carry no vma annotations (same constraint as
+                # ops/norm.py).
+                spec = P(batch_axis, None, None)
+                out = jax.shard_map(
+                    # scale closed over: custom_vjp nondiff args must stay
+                    # positional
+                    lambda qs, ks, vs: flash_attention(qs, ks, vs, scale),
+                    mesh=pallas_mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_vma=False)(q, k, v)
+            else:
+                out = flash_attention(q, k, v, scale)
         else:
             out = full_attention(q, k, v, scale=scale)
         if num_heads > 1:

@@ -108,13 +108,13 @@ def make_parallel_train(cfg: TrainConfig,
                     f"parallel meshes only, got mesh={dict(mesh.shape)} "
                     f"(spatial={cfg.mesh.spatial}); the fused kernels need "
                     "full channel vectors per shard")
-        elif cfg.model.attn_res:
-            raise ValueError(
-                "use_pallas + attn_res on a multi-device gspmd mesh is not "
-                "supported (the flash-attention pallas_call is opaque to "
-                "the partitioner); use backend='shard_map', --mesh_spatial "
-                "(ring x flash), or drop one flag")
         else:
+            # Pure-DP mesh: BOTH kernel families run per data-shard in
+            # nested shard_maps — the fused BN moments via ops/norm.py and
+            # (since r5) flash attention via ops/attention.py::attn_apply's
+            # pallas_mesh route, so the rev-2 attention presets (flash +
+            # XLA BN) scale over data-parallel meshes under the default
+            # backend too.
             pallas_mesh = mesh
     spatial = cfg.mesh.spatial
     img_sh = batch_sharding(mesh, 4, spatial=spatial)

@@ -18,7 +18,9 @@ tests/bench code) can materialize them without repeating knob soup:
 - ``wgan-gp``     — WGAN-GP loss variant: Wasserstein critic + gradient
   penalty (grad-of-grad), canonical lr 1e-4 / β1 0 hyperparameters.
 
-Plus one beyond-BASELINE family:
+Plus five beyond-BASELINE presets across three further model/recipe
+families (ten registered configs total — keep this count in sync with
+``PRESETS`` below):
 
 - ``sagan64``     — self-attention GAN (hinge + TTUR + EMA, attention at
   32x32), whose attention block is the framework's sequence-parallel
@@ -103,7 +105,18 @@ def sagan64(**overrides) -> TrainConfig:
     (synced) BatchNorm, not the paper's conditional BN.
     """
     cfg = _build(ModelConfig(output_size=64, attn_res=32,
-                             spectral_norm="gd"), MeshConfig(),
+                             spectral_norm="gd",
+                             # measured-best execution split (r5 chip probe:
+                             # 10.75 vs 15.70 ms/step, +46% throughput):
+                             # attention on the flash kernels, BN on XLA —
+                             # fused-BN Pallas loses ~20% at these shapes
+                             # (DESIGN.md §8b) while flash wins at S=1024.
+                             # Composes with every mesh: per-shard nested
+                             # shard_map on DP gspmd (attn_apply's
+                             # pallas_mesh route), ring x flash under
+                             # --mesh_spatial, per-shard under shard_map
+                             use_pallas=True, bn_pallas=False),
+                 MeshConfig(),
                  batch_size=64, loss="hinge", beta1=0.0,
                  d_learning_rate=4e-4, g_learning_rate=1e-4,
                  g_ema_decay=0.999)
@@ -117,7 +130,12 @@ def sagan128(**overrides) -> TrainConfig:
     --mesh_spatial) and the flash kernels (--use_pallas) earn their keep.
     Same recipe as sagan64 otherwise (hinge, SN both nets, TTUR, EMA)."""
     cfg = _build(ModelConfig(output_size=128, attn_res=64,
-                             spectral_norm="gd"), MeshConfig(),
+                             spectral_norm="gd",
+                             # same measured-best split as sagan64: flash
+                             # attention + XLA BN (S=4096 is deeper into
+                             # flash's winning regime, DESIGN.md §8)
+                             use_pallas=True, bn_pallas=False),
+                 MeshConfig(),
                  batch_size=64, loss="hinge", beta1=0.0,
                  d_learning_rate=4e-4, g_learning_rate=1e-4,
                  g_ema_decay=0.999)
@@ -135,7 +153,11 @@ def sagan256_lc(**overrides) -> TrainConfig:
     is D-only here — G's 2048-channel early stages make G-side power
     iteration the dominant non-attention cost at this depth."""
     cfg = _build(ModelConfig(output_size=256, attn_res=128,
-                             spectral_norm="d", use_pallas=True),
+                             spectral_norm="d", use_pallas=True,
+                             # r5: BN back on XLA — use_pallas exists here
+                             # for the flash ATTENTION path; the fused-BN
+                             # half measurably loses (DESIGN.md §8b)
+                             bn_pallas=False),
                  MeshConfig(),
                  # shard_map backend: use_pallas + attn_res composes with
                  # data-parallel meshes at ANY device count there (each
@@ -191,6 +213,22 @@ PRESETS: Dict[str, Callable[..., TrainConfig]] = {
     "sagan256-lc": sagan256_lc,
     "sngan-cifar10": sngan_cifar10,
     "stylegan64": stylegan64,
+}
+
+# Preset revisions: bump when a preset's PERF-RELEVANT config changes
+# (execution form, backend, batch policy — anything that moves its bench
+# row). bench.py stamps the revision into each preset capture and
+# tools/capture_all.py publishes best/spread over the highest revision
+# only, so a row's spread never mixes configs that no longer exist —
+# the same contract ops/pallas_attention.py::ATTN_GEN gives kernel
+# changes. Unlisted presets are revision 1.
+# rev 2 (r5): sagan64/sagan128 adopt flash attention + XLA BN
+# (chip-measured +46% on the sagan64-shape step); sagan256-lc splits
+# bn_pallas off its use_pallas flag.
+PRESET_REVS: Dict[str, int] = {
+    "sagan64": 2,
+    "sagan128": 2,
+    "sagan256-lc": 2,
 }
 
 

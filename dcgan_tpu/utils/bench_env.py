@@ -1,0 +1,81 @@
+"""The BENCH_* env knobs -> config, shared by every measurement entrypoint.
+
+bench.py (the driver's bench contract) and tools/step_profile.py (the
+roofline profiler) must build IDENTICAL configs from the same env — a
+profile row is only meaningful as the decomposition of a captured bench
+row. Round 4 kept two hand-copies of the parsing and they drifted
+(step_profile missed BENCH_ATTN_RES); this module is the single copy.
+
+Knobs handled here (model-shape only — batch/steps/scan/backends stay with
+their owners, they don't change WHAT is measured, only how long):
+
+  BENCH_PRESET     named preset (presets.py) instead of the flagship
+  BENCH_SIZE       output resolution (default 64)
+  BENCH_ATTN=1     self-attention at 32x32 (the sagan64-attn shape)
+  BENCH_SN=1       spectral norm on both nets
+  BENCH_PALLAS=1   use_pallas (flash attention; BN too unless split below)
+  BENCH_BN_PALLAS=0  keep BN on XLA while BENCH_PALLAS routes attention
+                   through the flash kernels — the measured-best split
+                   (DESIGN.md §8b)
+  BENCH_ATTN_RES=R attention at feature-map resolution R on top of
+                   whatever config the knobs above built (the long-context
+                   knob: R=128 at BENCH_SIZE=256 is S=16384)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional, Tuple
+
+from dcgan_tpu.config import ModelConfig, TrainConfig
+
+
+def bench_model_config(env=None) -> Tuple[ModelConfig, str]:
+    """(ModelConfig, label) from the non-preset BENCH_* model knobs."""
+    env = os.environ if env is None else env
+    mcfg = ModelConfig(
+        output_size=int(env.get("BENCH_SIZE", 64)),
+        use_pallas=env.get("BENCH_PALLAS", "") == "1",
+        bn_pallas=(False if env.get("BENCH_BN_PALLAS") == "0" else None),
+        attn_res=32 if env.get("BENCH_ATTN", "") == "1" else 0,
+        spectral_norm="gd" if env.get("BENCH_SN", "") == "1" else "none")
+    # the label must be injective over the knobs above — capture renders
+    # group by it, and two configs sharing a label would merge into one
+    # published row (the never-mix-configs contract)
+    size = mcfg.output_size
+    if mcfg.attn_res:
+        label = f"sagan{size}-attn"
+    else:
+        label = "headline" if size == 64 else f"dcgan{size}"
+    if mcfg.use_pallas:
+        # "-flash" = flash attention with BN split back to XLA (the
+        # measured-best form); "-pallas" = both kernel families engaged;
+        # "-pallas-xlabn" = the degenerate no-attention + BN-split combo
+        # (no Pallas kernel actually runs — kept distinct so it can never
+        # merge with the fused-BN row)
+        if mcfg.attn_res and mcfg.bn_pallas is False:
+            label += "-flash"
+        elif mcfg.bn_pallas is False:
+            label += "-pallas-xlabn"
+        else:
+            label += "-pallas"
+    if mcfg.spectral_norm != "none":
+        label += "-sn"
+    return mcfg, label
+
+
+def apply_attn_res_override(cfg: TrainConfig, env=None) -> TrainConfig:
+    """BENCH_ATTN_RES on top of ANY built config (preset or default).
+
+    Only overrides use_pallas when BENCH_PALLAS is explicitly set — a
+    preset's own use_pallas must survive an attn_res-only override.
+    """
+    env = os.environ if env is None else env
+    if not env.get("BENCH_ATTN_RES"):
+        return cfg
+    model_kw = {"attn_res": int(env["BENCH_ATTN_RES"])}
+    if "BENCH_PALLAS" in env:
+        model_kw["use_pallas"] = env["BENCH_PALLAS"] == "1"
+    return dataclasses.replace(
+        cfg, model=dataclasses.replace(cfg.model, **model_kw))

@@ -94,6 +94,12 @@ def test_spatial_sharding_rules():
      # reference runs flash + fused-BN — both exact, so they must agree
      pytest.param(MeshConfig(model=2, spatial=True), "ring-flash", False,
                   id="dp4xsp2-ringflash", marks=pytest.mark.slow),
+     # pure-DP gspmd + flash attention + XLA BN (r5): the flash kernels
+     # run per data-shard through attn_apply's pallas_mesh nested
+     # shard_map — the rev-2 attention presets' execution form; must
+     # match the single-device step exactly like every other partitioning
+     pytest.param(MeshConfig(), "dp-flash", False, id="dp8-flash",
+                  marks=pytest.mark.slow),
      pytest.param(MeshConfig(shard_opt=True), TINY, False, id="dp8-zero1",
                   marks=pytest.mark.slow),
      pytest.param(MeshConfig(), "cbn", True, id="dp8-cbn",
@@ -111,6 +117,9 @@ def test_sharded_step_matches_single_device(mesh_cfg, model, conditional):
         model = dataclasses.replace(TINY, num_classes=4, conditional_bn=True)
     elif model == "ring-flash":
         model = dataclasses.replace(TINY, attn_res=8, use_pallas=True)
+    elif model == "dp-flash":
+        model = dataclasses.replace(TINY, attn_res=8, use_pallas=True,
+                                    bn_pallas=False)
     cfg = TrainConfig(model=model, batch_size=16, mesh=mesh_cfg)
     xs, key = real_batch(), jax.random.key(3)
     labels = (jnp.asarray(np.arange(16) % model.num_classes),) \

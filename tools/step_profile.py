@@ -1,9 +1,15 @@
-"""Per-component chip profile of the headline train step (the MFU numerator).
+"""Per-component chip profile of a train step (the MFU numerator).
 
 VERDICT r3 #1 (weak #2): the headline's "~64 TFLOP/s" effective rate had no
 in-repo breakdown — no per-op split of the 2.91 ms step and no reproducible
-FLOP count. This tool measures both, standalone or under capture_all
-(section "roofline"):
+FLOP count. VERDICT r4 #5 extended the same question to the families below
+the 4x north star (dcgan128, wgan-gp, sagan64-attn): are they at THEIR
+roofs, or leaving throughput on the table? This tool measures both — for
+the headline config by default, or any preset/knob combo via the same
+BENCH_PRESET / BENCH_ATTN / BENCH_SN / BENCH_PALLAS / BENCH_SIZE env vars
+bench.py reads (so a profile always describes exactly the config of a
+captured bench row) — standalone or under capture_all (section
+"roofline"):
 
 - `compiled.cost_analysis()` on the exact headline train-step program gives
   the XLA FLOP count (the numerator of every TFLOP/s claim in DESIGN.md).
@@ -59,17 +65,44 @@ def main() -> None:
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    from dcgan_tpu.config import ModelConfig, TrainConfig
+    import dataclasses
+
+    from dcgan_tpu.config import TrainConfig
     from dcgan_tpu.train.steps import make_optimizer, make_train_step
     from dcgan_tpu.utils.backend import acquire_devices
 
     acquire_devices()
-    cfg = TrainConfig(model=ModelConfig(), batch_size=BATCH)
+    # same config knobs as bench.py — one shared parser
+    # (dcgan_tpu/utils/bench_env.py), so every profile row decomposes
+    # exactly a captured bench config (VERDICT r4 #5)
+    from dcgan_tpu.utils.bench_env import (
+        apply_attn_res_override,
+        bench_model_config,
+    )
+
+    preset_name = os.environ.get("BENCH_PRESET", "")
+    if preset_name:
+        from dcgan_tpu.presets import get_preset
+
+        cfg = dataclasses.replace(get_preset(preset_name),
+                                  batch_size=BATCH)
+        profile_of = preset_name
+    else:
+        mcfg, profile_of = bench_model_config()
+        cfg = TrainConfig(model=mcfg, batch_size=BATCH)
+    cfg = apply_attn_res_override(cfg)
+    if os.environ.get("BENCH_ATTN_RES"):
+        profile_of += f"-attn{os.environ['BENCH_ATTN_RES']}"
+    if cfg.model.num_classes:
+        raise SystemExit(
+            "step_profile does not thread class labels; profile the "
+            "unconditional families")
     fns = make_train_step(cfg)
 
     state = jax.jit(fns.init)(jax.random.key(0))
+    size = cfg.model.output_size
     images = jnp.asarray(np.random.default_rng(0).uniform(
-        -1, 1, size=(BATCH, 64, 64, 3)).astype(np.float32))
+        -1, 1, size=(BATCH, size, size, cfg.model.c_dim)).astype(np.float32))
     base = jax.random.key(1)
     keys = jax.random.split(base, SCAN)
     zs = jax.random.uniform(base, (SCAN, BATCH, cfg.model.z_dim),
@@ -187,6 +220,7 @@ def main() -> None:
 
     summary = {
         "label": "step-profile",
+        "preset": profile_of,
         "batch": BATCH, "scan": SCAN,
         "step_ms": round(step_ms, 4),
         "fwd_ms": round(fwd_ms, 4),
