@@ -1,0 +1,143 @@
+"""Multi-process loader scaling: M loader processes, per-process shard
+ownership, one synchronized measurement window (VERDICT r4 #2).
+
+The question the chip's own numbers raise: the b512 peak regime consumes
+32.6k img/s/chip while the measured ONE-CORE uint8 loader ceiling is ~26.5k
+img/s — can the pipeline feed the peak? The design answer is process-level
+scaling: `pipeline.shard_for_process` gives process i shards i, i+P, ... (the
+exact ownership `--multihost` training uses), so loader throughput scales by
+adding reader PROCESSES pinned to distinct cores, no shared state to contend
+on. This tool measures that aggregate:
+
+- parent writes one synthetic shard set (reference wire schema, uint8 by
+  default — prepare.py's default since r4);
+- M worker processes each own their `shard_for_process` slice, warm up,
+  then measure over the SAME wall-clock window (parent-assigned start/end
+  timestamps, so per-process rates are concurrent and sum honestly);
+- one JSON line per M with per-process and aggregate rates, plus the
+  visible-core count (`os.sched_getaffinity`) — on a single-core host the
+  aggregate stays flat by construction and the per-core rate is the budget
+  number; on an N-core host the aggregate demonstrates the scaling itself.
+
+    python tools/bench_loader_scale.py                 # M = 1, 2
+    python tools/bench_loader_scale.py --processes 1 4 8 --seconds 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _worker() -> None:
+    """Child body: own shard slice -> NativeLoader -> timed window."""
+    spec = json.loads(os.environ["LOADER_SCALE_SPEC"])
+    from dcgan_tpu.data.native import NativeLoader
+    from dcgan_tpu.data.pipeline import shard_for_process
+
+    paths = shard_for_process(spec["paths"], spec["pid"], spec["nproc"])
+    shape = tuple(spec["shape"])
+    batch = spec["batch"]
+    ld = NativeLoader(paths, n_threads=spec["threads"], batch=batch,
+                      example_shape=shape, record_dtype=spec["record_dtype"],
+                      min_after_dequeue=4 * batch, prefetch_batches=4,
+                      seed=spec["pid"], normalize=True, loop=True)
+    try:
+        for _ in range(3):
+            ld.next()
+        while time.time() < spec["start_ts"]:  # shared window start
+            time.sleep(0.005)
+        n = 0
+        while time.time() < spec["end_ts"]:
+            ld.next()
+            n += batch
+        # actual span can overshoot end_ts by one batch; charge the real
+        # time — measured BEFORE close() so reader-thread teardown is not
+        # billed to the throughput window
+        span = time.time() - spec["start_ts"]
+    finally:
+        ld.close()
+    print(json.dumps({"pid": spec["pid"], "images": n,
+                      "span_s": round(span, 3),
+                      "images_per_sec": round(n / span, 1),
+                      "shards_owned": len(paths)}))
+
+
+def main() -> None:
+    if os.environ.get("LOADER_SCALE_SPEC"):
+        _worker()
+        return
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--processes", type=int, nargs="+", default=[1, 2])
+    p.add_argument("--threads", type=int, default=16,
+                   help="reader threads per process (clamped to owned shards)")
+    p.add_argument("--record_dtype", default="uint8",
+                   choices=["float64", "float32", "uint8"])
+    p.add_argument("--image_size", type=int, default=64)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--num_examples", type=int, default=8192)
+    p.add_argument("--num_shards", type=int, default=32,
+                   help="total shards; each of M processes owns ~shards/M")
+    p.add_argument("--seconds", type=float, default=6.0,
+                   help="shared measurement window length")
+    p.add_argument("--warmup_s", type=float, default=8.0,
+                   help="lead time for children to import + warm up")
+    args = p.parse_args()
+
+    from dcgan_tpu.data.synthetic import write_image_tfrecords
+
+    cores = len(os.sched_getaffinity(0))
+    with tempfile.TemporaryDirectory() as tmp:
+        paths = write_image_tfrecords(
+            tmp, num_examples=args.num_examples,
+            image_size=args.image_size, num_shards=args.num_shards,
+            record_dtype=args.record_dtype)
+        shape = (args.image_size, args.image_size, 3)
+
+        for m in args.processes:
+            start = time.time() + args.warmup_s
+            end = start + args.seconds
+            procs = []
+            for pid in range(m):
+                spec = {"paths": paths, "pid": pid, "nproc": m,
+                        "threads": args.threads, "batch": args.batch,
+                        "shape": shape, "record_dtype": args.record_dtype,
+                        "start_ts": start, "end_ts": end}
+                procs.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=dict(os.environ,
+                             LOADER_SCALE_SPEC=json.dumps(spec)),
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                    text=True))
+            rows = []
+            for pr in procs:
+                out, err = pr.communicate(timeout=args.warmup_s
+                                          + args.seconds + 120)
+                if pr.returncode != 0:
+                    raise SystemExit(f"worker failed:\n{err[-2000:]}")
+                rows.append(json.loads(out.strip().splitlines()[-1]))
+            print(json.dumps({
+                "label": "loader-scale",
+                "processes": m,
+                "threads_per_process": args.threads,
+                "record_dtype": args.record_dtype,
+                "cores_visible": cores,
+                "aggregate_images_per_sec": round(
+                    sum(r["images_per_sec"] for r in rows), 1),
+                "per_process_images_per_sec": [r["images_per_sec"]
+                                               for r in rows],
+                "window_s": args.seconds,
+            }))
+
+
+if __name__ == "__main__":
+    main()

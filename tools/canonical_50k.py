@@ -1,0 +1,134 @@
+"""Run the CANONICAL feature path at the 50k contract with a stand-in embedder.
+
+The eval duty being replaced is the reference's only quality signal
+(image_train.py:179-192 — the human eyeballing sample grids); this repo's
+replacement is FID-50k (evals/job.py). Its default embedder is the fixed-seed
+random-conv surrogate; the CANONICAL path — a trained torch embedder imported
+through tools/convert_torch_embedder.py's .npz schema — was parity-tested but
+had never carried a real eval at contract scale (VERDICT r4 #4). This tool
+closes that gap without egress:
+
+1. builds a RANDOM-weight torch conv tower (torch is in the image; weights
+   need no downloads — the point is exercising the code path, not the score),
+2. exports its state_dict and converts it with tools/convert_torch_embedder.py
+   (the exact command a user with real InceptionV3/trained-tower weights runs),
+3. materializes a step-0 checkpoint (flagship DCGAN-64 config.json + Orbax
+   state — evals restores it like any trained checkpoint),
+4. runs `python -m dcgan_tpu.evals --feature_npz <npz> --num_samples 50000
+   --kid --synthetic` end to end and re-emits its JSON.
+
+With real weights the ONLY change is step 1 (see README "Canonical FID").
+
+Prints one JSON line:
+  {"label": "canonical-npz-50k", "fid": ..., "kid": ..., "num_samples": ...,
+   "feature_dim": ..., "embedder": "...", "elapsed_s": ...}
+
+Env knobs: BENCH_PLATFORM=cpu + CANON_SAMPLES=1024 for a smoke run;
+defaults are the chip + the full 50k contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+N_SAMPLES = int(os.environ.get("CANON_SAMPLES", 50_000))
+
+
+def _build_torch_tower(pt_path: str) -> str:
+    """A 4-stage stride-2 conv tower with torch-native random init — the
+    stand-in for a trained embedder (same state_dict schema torchvision
+    towers or custom-trained towers export)."""
+    import torch
+    from torch import nn
+
+    torch.manual_seed(0)
+    tower = nn.Sequential(
+        nn.Conv2d(3, 32, 5, stride=2, padding=2), nn.LeakyReLU(0.2),
+        nn.Conv2d(32, 64, 5, stride=2, padding=2), nn.LeakyReLU(0.2),
+        nn.Conv2d(64, 128, 3, stride=2, padding=1), nn.LeakyReLU(0.2),
+        nn.Conv2d(128, 256, 3, stride=2, padding=1),
+    )
+    torch.save(tower.state_dict(), pt_path)
+    return "random-torch-4conv(32,64,128,256)"
+
+
+def _make_checkpoint(ckpt_dir: str) -> None:
+    """Step-0 flagship checkpoint + config.json, exactly what evals restores
+    (random weights: the contract under test is the feature path, not the
+    generator's quality)."""
+    import jax
+
+    from dcgan_tpu.config import ModelConfig, TrainConfig, save_config
+    from dcgan_tpu.parallel import make_mesh, make_parallel_train
+    from dcgan_tpu.utils.checkpoint import Checkpointer
+
+    cfg = TrainConfig(model=ModelConfig(), batch_size=64,
+                      checkpoint_dir=ckpt_dir)
+    pt = make_parallel_train(cfg, make_mesh(cfg.mesh))
+    state = pt.init(jax.random.key(0))
+    ckpt = Checkpointer(ckpt_dir)
+    ckpt.save(0, state, force=True)
+    ckpt.wait()
+    ckpt.close()
+    save_config(cfg, ckpt_dir)
+
+
+def main() -> None:
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory() as tmp:
+        pt_path = os.path.join(tmp, "tower.pt")
+        npz_path = os.path.join(tmp, "features.npz")
+        ckpt_dir = os.path.join(tmp, "ckpt")
+
+        embedder = _build_torch_tower(pt_path)
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "convert_torch_embedder.py"),
+             "--state_dict", pt_path, "--proj_dim", "512",
+             "--out", npz_path],
+            capture_output=True, text=True)
+        if res.returncode != 0:
+            raise SystemExit(f"convert failed:\n{res.stderr[-2000:]}")
+        print(res.stdout.strip(), file=sys.stderr)
+
+        _make_checkpoint(ckpt_dir)
+
+        argv = [sys.executable, "-m", "dcgan_tpu.evals",
+                "--checkpoint_dir", ckpt_dir, "--synthetic",
+                "--feature_npz", npz_path,
+                "--num_samples", str(N_SAMPLES), "--kid"]
+        if os.environ.get("BENCH_PLATFORM"):
+            argv += ["--platform", os.environ["BENCH_PLATFORM"]]
+        res = subprocess.run(argv, cwd=REPO, capture_output=True, text=True)
+        sys.stderr.write((res.stderr or "")[-1500:])
+        if res.returncode != 0:
+            raise SystemExit(f"evals failed:\n{(res.stdout or '')[-800:]}")
+        score = None
+        for line in (res.stdout or "").splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                score = json.loads(line)
+        if score is None or score.get("num_samples") != N_SAMPLES:
+            raise SystemExit(f"no {N_SAMPLES}-sample score line in evals "
+                             f"output:\n{(res.stdout or '')[-800:]}")
+
+    print(json.dumps({
+        "label": "canonical-npz-50k",
+        "fid": score["fid"],
+        "kid": score.get("kid"),
+        "num_samples": score["num_samples"],
+        "feature_dim": score.get("feature_dim"),
+        "embedder": embedder,
+        "elapsed_s": round(time.monotonic() - t0, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()
