@@ -88,6 +88,11 @@ STEPS = [
            BENCH_BN_PALLAS="0"),
     _bench("sagan64-attn-sn-flash", BENCH_ATTN="1", BENCH_SN="1",
            BENCH_PALLAS="1", BENCH_BN_PALLAS="0"),
+    # the attention family's batch-scaling point: does the flash form keep
+    # the headline's rising-throughput curve (DESIGN.md §1b) once the
+    # score-matrix traffic is gone?
+    _bench("sagan64-attn-flash-b256", BENCH_ATTN="1", BENCH_PALLAS="1",
+           BENCH_BN_PALLAS="0", BENCH_BATCH="256"),
     _bench("dcgan64-pallas", BENCH_PALLAS="1"),
     _bench("dcgan64-shard_map", BENCH_BACKEND="shard_map"),
     _bench("dcgan64-sample", BENCH_MODE="sample"),
@@ -654,8 +659,14 @@ def render_docs() -> None:
         # host-core budget (VERDICT r4 #2): the per-core uint8 rate vs the
         # measured chip peak, derived from this same captures log so the
         # paragraph regenerates with every harvest
+        # per-core uint8 rate: best of the single-thread-pool ceilings AND
+        # the scale tool's M=1 row (same quantity, measured on the quiet
+        # host through the shard-ownership path)
         uint8 = [p["images_per_sec"] for p, _ in loader
                  if p.get("record_dtype") == "uint8"]
+        uint8 += [v for p, _ in scale
+                  if p["processes"] == 1 and p.get("record_dtype") == "uint8"
+                  for v in p["per_process_images_per_sec"]]
 
         def _vals(label):
             return [p["value"] for r in rows
