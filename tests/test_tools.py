@@ -52,6 +52,45 @@ class TestSpread:
         assert a["n"] == 2 and a["min"] == 10.0 and a["max"] == 20.0
         assert a["median"] == 15.0
 
+    def test_best_rows_publish_highest_generation_only(self):
+        """VERDICT r4 #1's contract: an attention label's best AND spread
+        come from the highest kernel generation on record — a median over
+        mixed generations describes no code that exists. Unstamped history
+        is gen 0 (superseded once stamps appear)."""
+        rows = [
+            {"section": "matrix", "label": "attn", "rc": 0, "date": "d1",
+             "parsed": [{"value": 3250.0}]},                  # pre-stamp
+            {"section": "matrix", "label": "attn", "rc": 0, "date": "d2",
+             "parsed": [{"value": 3260.0, "gen": 1}]},        # superseded
+            {"section": "matrix", "label": "attn", "rc": 0, "date": "d3",
+             "parsed": [{"value": 4050.0, "gen": 2}]},
+            {"section": "matrix", "label": "attn", "rc": 0, "date": "d4",
+             "parsed": [{"value": 4080.0, "gen": 2}]},
+        ]
+        a = _best_bench_rows(rows)["attn"]
+        assert a["value"] == 4080.0 and a["gen"] == 2
+        assert a["n"] == 2 and a["min"] == 4050.0  # gen<2 rows excluded
+
+    def test_best_rows_preset_revision_default_is_one(self):
+        """Unlisted presets ARE revision 1, so pre-stamp history of
+        UNCHANGED presets must stay in the spread when a stamped rev-1
+        capture arrives — only history behind an explicit bump retires
+        (advisor r5 fix: a default of 0 silently discarded every unchanged
+        preset's history on the first stamped harvest)."""
+        rows = [
+            {"section": "matrix", "label": "p", "rc": 0, "date": "d1",
+             "parsed": [{"value": 100.0}]},                   # pre-stamp
+            {"section": "matrix", "label": "p", "rc": 0, "date": "d2",
+             "parsed": [{"value": 110.0, "rev": 1}]},         # same config
+        ]
+        p = _best_bench_rows(rows)["p"]
+        assert p["n"] == 2 and p["min"] == 100.0 and p["value"] == 110.0
+        # an explicit bump DOES retire older rows
+        rows.append({"section": "matrix", "label": "p", "rc": 0,
+                     "date": "d3", "parsed": [{"value": 90.0, "rev": 2}]})
+        p = _best_bench_rows(rows)["p"]
+        assert p["n"] == 1 and p["value"] == 90.0 and p["rev"] == 2
+
     def test_roofline_render(self):
         rows = [
             {"section": "roofline", "label": "matmul-rate", "rc": 0,
@@ -175,6 +214,49 @@ class TestTrainerLoopParsing:
 
 @pytest.mark.slow
 class TestToolsRunOnCpu:
+    def test_loader_scale_two_processes(self):
+        """The multi-process loader-scaling tool end to end on tiny shards:
+        two workers own disjoint `shard_for_process` slices, measure over
+        one shared wall window, and the parent emits well-formed aggregate
+        rows (the numbers only mean anything on a quiet multi-core host —
+        the contract here is protocol + JSON shape)."""
+        res = subprocess.run(
+            [sys.executable, "tools/bench_loader_scale.py",
+             "--processes", "1", "2", "--seconds", "1.5", "--warmup_s", "5",
+             "--num_examples", "512", "--num_shards", "8", "--threads",
+             "4"],
+            cwd=REPO, env=dict(os.environ), capture_output=True, text=True,
+            timeout=300)
+        assert res.returncode == 0, res.stderr[-800:]
+        lines = [json.loads(l) for l in res.stdout.splitlines()
+                 if l.startswith("{")]
+        assert [p["processes"] for p in lines] == [1, 2]
+        for p in lines:
+            assert p["label"] == "loader-scale"
+            assert len(p["per_process_images_per_sec"]) == p["processes"]
+            assert p["aggregate_images_per_sec"] == pytest.approx(
+                sum(p["per_process_images_per_sec"]), abs=0.5)
+            assert p["cores_visible"] >= 1
+
+    def test_canonical_50k_tool_cpu(self):
+        """tools/canonical_50k.py end to end at toy scale: random torch
+        tower -> convert_torch_embedder .npz -> step-0 checkpoint ->
+        `python -m dcgan_tpu.evals --feature_npz` — the exact pipeline the
+        chip row in BASELINE.md certifies at 50k, pinned here so the tool
+        cannot rot (the score is arbitrary; the contract is that the
+        canonical path executes and reports the requested sample count)."""
+        res = subprocess.run(
+            [sys.executable, "tools/canonical_50k.py"], cwd=REPO,
+            env=dict(os.environ, BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu",
+                     CANON_SAMPLES="64"),
+            capture_output=True, text=True, timeout=900)
+        assert res.returncode == 0, (res.stderr[-800:], res.stdout[-300:])
+        row = json.loads(res.stdout.strip().splitlines()[-1])
+        assert row["label"] == "canonical-npz-50k"
+        assert row["num_samples"] == 64
+        assert row["fid"] > 0 and row["feature_dim"] == 512
+        assert "torch" in row["embedder"]
+
     def test_matmul_rate_cpu(self):
         env = dict(os.environ, BENCH_PLATFORM="cpu", JAX_PLATFORMS="cpu",
                    MATMUL_SHAPES="64x64,64x128", MATMUL_ITERS="2",

@@ -88,11 +88,16 @@ STEPS = [
            BENCH_BN_PALLAS="0"),
     _bench("sagan64-attn-sn-flash", BENCH_ATTN="1", BENCH_SN="1",
            BENCH_PALLAS="1", BENCH_BN_PALLAS="0"),
-    # the attention family's batch-scaling point: does the flash form keep
+    # the attention family's batch-scaling points: does the flash form keep
     # the headline's rising-throughput curve (DESIGN.md §1b) once the
     # score-matrix traffic is gone?
     _bench("sagan64-attn-flash-b256", BENCH_ATTN="1", BENCH_PALLAS="1",
            BENCH_BN_PALLAS="0", BENCH_BATCH="256"),
+    _bench("sagan64-attn-flash-b512", BENCH_ATTN="1", BENCH_PALLAS="1",
+           BENCH_BN_PALLAS="0", BENCH_BATCH="512"),
+    # the full sagan64 preset (hinge + SN both nets + TTUR + EMA on the
+    # rev-2 flash/XLA-BN split) — the recipe row, vs the knob rows above
+    _bench("sagan64", BENCH_PRESET="sagan64"),
     _bench("dcgan64-pallas", BENCH_PALLAS="1"),
     _bench("dcgan64-shard_map", BENCH_BACKEND="shard_map"),
     _bench("dcgan64-sample", BENCH_MODE="sample"),
