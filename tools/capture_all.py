@@ -98,6 +98,14 @@ STEPS = [
     # the full sagan64 preset (hinge + SN both nets + TTUR + EMA on the
     # rev-2 flash/XLA-BN split) — the recipe row, vs the knob rows above
     _bench("sagan64", BENCH_PRESET="sagan64"),
+    # sagan128: attention at 64x64 (S=4096) — deeper into flash's winning
+    # regime; the preset's first captured number
+    _bench("sagan128", timeout=600, BENCH_PRESET="sagan128",
+           BENCH_STEPS="200", BENCH_SCAN="25"),
+    # inference (sampler) rows for the attention family — the serve path
+    # with the flash kernels in the generator
+    _bench("sagan64-attn-flash-sample", BENCH_MODE="sample",
+           BENCH_ATTN="1", BENCH_PALLAS="1", BENCH_BN_PALLAS="0"),
     _bench("dcgan64-pallas", BENCH_PALLAS="1"),
     _bench("dcgan64-shard_map", BENCH_BACKEND="shard_map"),
     _bench("dcgan64-sample", BENCH_MODE="sample"),
@@ -545,8 +553,14 @@ def render_docs() -> None:
         for label in sorted(sample):
             b = sample[label]
             ms = f"{b['ms']:.2f}" if b.get("ms") else "—"
-            lines.append(f"| {label} | {b['value']} | {_sp(b)} | {ms} | "
-                         f"{b['date']} |")
+            # same provenance tags as the train table: gen filtering
+            # applies to these rows too, so it must be visible
+            tag = (f" (attn gen {b['gen']})" if b.get("gen") is not None
+                   else "")
+            if b.get("rev") and b["rev"] > 1:
+                tag += f" (rev {b['rev']})"
+            lines.append(f"| {label}{tag} | {b['value']} | {_sp(b)} | {ms} "
+                         f"| {b['date']} |")
     else:
         lines += ["No successful chip captures yet (tunnel down every "
                   "attempt so far — every attempt is logged in "
