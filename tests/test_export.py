@@ -105,6 +105,52 @@ class TestExportSampler:
         other = np.asarray(exported.call(z, np.zeros(4, np.int32)))
         assert not np.allclose(imgs[1:], other[1:])
 
+    def test_flash_trained_attention_checkpoint_exports_dense(
+            self, tmp_path_factory, tmp_path):
+        """The rev-2 sagan presets TRAIN with the flash kernels
+        (use_pallas=True); their checkpoints must still export — the
+        artifact forces the dense lowering for StableHLO portability
+        (export.py's use_pallas=False replace), and attention parameters
+        are execution-form-agnostic, so the flash-trained weights serve
+        through the dense sampler unchanged."""
+        ckpt = _train_ckpt(tmp_path_factory.mktemp("export_attn"),
+                           attn_res=8, use_pallas=True, bn_pallas=False)
+        out = str(tmp_path / "attn.jaxexport")
+        meta = export_sampler(
+            ckpt, out, overrides={"output_size": 16, "gf_dim": 8,
+                                  "df_dim": 8},
+            platforms=("cpu",))
+        assert meta["z_dim"] == 100
+        exported = load_sampler(out)
+        z = np.random.default_rng(1).uniform(
+            -1, 1, size=(8, 100)).astype(np.float32)
+        imgs = np.asarray(exported.call(z))
+        assert imgs.shape == (8, 16, 16, 3)
+        assert np.abs(imgs).max() <= 1.0
+        assert np.isfinite(imgs).all()
+
+        # exact check against the framework sampler running the FLASH form
+        # (interpret kernels on CPU): both attention forms are exact, so
+        # the dense-lowered artifact must reproduce the flash-path images
+        # to f32 tolerance — this is what pins the restored attention
+        # parameters to the right wiring
+        import jax
+
+        from dcgan_tpu.parallel import make_mesh, make_parallel_train
+        from dcgan_tpu.utils.checkpoint import Checkpointer
+
+        cfg = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8,
+                                            df_dim=8, attn_res=8,
+                                            use_pallas=True,
+                                            bn_pallas=False,
+                                            compute_dtype="float32"),
+                          batch_size=8, checkpoint_dir=ckpt)
+        pt = make_parallel_train(cfg, make_mesh(cfg.mesh))
+        state = Checkpointer(ckpt).restore_latest(pt.init(jax.random.key(0)))
+        ref = np.asarray(jax.device_get(
+            pt.sample(state, jax.numpy.asarray(z))))
+        np.testing.assert_allclose(imgs, ref, atol=1e-5)
+
     def test_cli_and_flag_coverage(self, ckpt, tmp_path):
         parser = build_parser()
         args = parser.parse_args(["--checkpoint_dir", ckpt])
