@@ -92,9 +92,16 @@ def _bench_sample(cfg, pt, state, n_chips: int) -> None:
         "vs_baseline": None,
     }
     if cfg.model.attn_res:
-        # same generation stamp as the train rows (VERDICT r4 #1)
-        from dcgan_tpu.ops.pallas_attention import ATTN_GEN
-        row["gen"] = ATTN_GEN
+        # same generation stamp as the train rows (VERDICT r4 #1), with the
+        # same flash/dense split (ADVICE r5 #1): stamp the generation of the
+        # attention code this config actually EXECUTES, so a flash-only
+        # ATTN_GEN bump can never retire dense sampler capture history
+        if cfg.model.use_pallas:
+            from dcgan_tpu.ops.pallas_attention import ATTN_GEN
+            row["gen"] = ATTN_GEN
+        else:
+            from dcgan_tpu.ops.attention import DENSE_ATTN_GEN
+            row["gen"] = DENSE_ATTN_GEN
     print(json.dumps(row))
     print(f"chips={n_chips} batch={batch} calls={n_calls} wall={dt:.2f}s "
           f"ms_per_step={dt / n_calls * 1e3:.2f}", file=sys.stderr)

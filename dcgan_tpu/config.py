@@ -308,6 +308,15 @@ class TrainConfig:
     label_feature: str = "label"   # int64 per-example class feature, read when
                                    # model.num_classes > 0 (the schema the
                                    # reference comments out, image_input.py:44)
+    prefetch_device_batches: int = 2  # depth of the background device-feed
+                                   # queue (data/pipeline.DevicePrefetcher):
+                                   # a transfer thread keeps this many
+                                   # already-sharded device batches ready
+                                   # ahead of the dispatch thread, so batch
+                                   # assembly + H2D transfer overlap device
+                                   # compute. 0 = legacy consumer-thread
+                                   # double buffer (feed alternates with
+                                   # dispatch)
     synthetic_device_cache: int = 0  # >0 (synthetic data only): pre-stage
                                    # this many sharded batches ON DEVICE and
                                    # cycle them — removes host->device feed
@@ -317,6 +326,21 @@ class TrainConfig:
                                    # the feed (tools/bench_trainer_loop.py)
 
     # Observability (image_train.py:37,129,179)
+    async_services: bool = True    # run host-side observability (deferred
+                                   # metric materialization, param/activation
+                                   # histogram capture, sample-grid PNG
+                                   # encode, JSONL/TB writes) on a background
+                                   # single-worker executor with drop-oldest
+                                   # backpressure (train/services.py), and
+                                   # log step N's scalars while step N+1 runs
+                                   # (lag-by-one). False = every service runs
+                                   # inline on the dispatch thread at its
+                                   # original call site — the pre-async loop
+                                   # structure; the metrics JSONL matches the
+                                   # pre-async trainer's up to the two new
+                                   # perf/host_ms_mean + perf/
+                                   # dispatch_occupancy timing keys (emitted
+                                   # in both modes)
     checkpoint_dir: str = "checkpoint"
     sample_dir: str = "samples"
     tensorboard: bool = True       # mirror metrics into TensorBoard-native
@@ -476,6 +500,10 @@ class TrainConfig:
             raise ValueError(
                 "update_mode='fused' (reference-parity single fused step) is "
                 "defined only for n_critic=1")
+        if self.prefetch_device_batches < 0:
+            raise ValueError(
+                f"prefetch_device_batches must be >= 0, got "
+                f"{self.prefetch_device_batches}")
         if self.grad_accum < 1:
             raise ValueError(
                 f"grad_accum must be >= 1, got {self.grad_accum}")

@@ -42,6 +42,15 @@ class DataConfig:
     prefetch_batches: int = 8       # measured best on a 1-core host (+3-15%
                                     # vs 4 — smooths bursty consumers like
                                     # the scanned multi-step dispatch)
+    prefetch_device_batches: int = 2  # depth of the DEVICE-side feed queue:
+                                    # a background thread assembles host
+                                    # batches and starts their H2D transfer,
+                                    # keeping up to N already-sharded device
+                                    # batches ready ahead of the consumer —
+                                    # host batch assembly + transfer overlap
+                                    # device compute instead of alternating
+                                    # with it. 0 = the legacy single-slot
+                                    # double buffer on the consumer thread
     seed: int = 0
     normalize: bool = True          # [-1,1]; False = strict reference parity
     feature_name: str = "image_raw"
@@ -377,6 +386,136 @@ def to_global(batch, sharding, label_sharding=None):
     return jax.make_array_from_process_local_data(sharding, batch)
 
 
+def _check_labels(batch, num_classes: int):
+    """Host-side label-range gate (see DataConfig.num_classes) — shared by
+    the inline and prefetch-thread feed paths."""
+    labels = batch[1]
+    bad = int(labels.max(initial=0))
+    if bad >= num_classes or int(labels.min(initial=0)) < 0:
+        raise ValueError(
+            f"label {bad} out of range for num_classes="
+            f"{num_classes} (dataset/config mismatch; on device "
+            "this would silently one-hot to zeros or clamp the cBN "
+            "table gather)")
+
+
+class DevicePrefetcher:
+    """Background device-feed thread: host batches -> a bounded queue of
+    already-sharded global device arrays.
+
+    The single-slot double buffer this replaces still ran batch assembly
+    and the H2D transfer start on the CONSUMER's thread — the trainer's
+    dispatch thread alternated between feeding and dispatching (the stall
+    class ParaGAN's congestion-aware pipeline attacks, PAPERS.md
+    arxiv 2411.03999). Here one producer thread pulls `host_iter`,
+    validates labels, and calls `to_global` (which starts the transfer),
+    so up to `depth` device batches sit ready while the device computes.
+
+    Order is the host iterator's order (single producer, FIFO queue).
+    Producer exceptions re-raise on the consumer thread at the next
+    `__next__`. `close()` is idempotent, safe mid-epoch, unblocks a
+    producer stuck on a full queue, and closes `owner` (the underlying
+    loader) when given.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, host_iter: Iterator, sharding, label_sharding=None, *,
+                 depth: int = 2, num_classes: int = 0, owner=None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._host_iter = host_iter
+        self._sharding = sharding
+        self._label_sharding = label_sharding
+        self._num_classes = num_classes
+        self._owner = owner
+        self._queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._produce, name="dcgan-device-feed", daemon=True)
+        self._thread.start()
+
+    def _put(self, item) -> bool:
+        """Queue-put that stays interruptible by close()."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self) -> None:
+        try:
+            for batch in self._host_iter:
+                if self._stop.is_set():
+                    return
+                if self._num_classes and isinstance(batch, tuple):
+                    _check_labels(batch, self._num_classes)
+                arr = to_global(batch, self._sharding, self._label_sharding)
+                if not self._put(arr):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised on consumer
+            self._error = e
+        finally:
+            self._put(self._SENTINEL)
+
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self):
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                item = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                # producer still filling (or wedged on a slow loader) —
+                # keep waiting unless it died with an error
+                if self._error is not None and not self._thread.is_alive():
+                    self._raise()
+                continue
+            if item is self._SENTINEL:
+                if self._error is not None:
+                    self._raise()
+                raise StopIteration
+            return item
+
+    def _raise(self):
+        err = self._error
+        self._error = None
+        self.close()
+        # re-raise the producer's exception with its original type and
+        # traceback — consumers match on the loader's own error classes
+        raise err
+
+    def close(self) -> None:
+        """Stop the producer and release the loader. Mid-epoch safe: any
+        queued device batches are discarded. The owner loader closes
+        BEFORE the join — a producer parked in the loader's untimed batch
+        get() is unblocked by the loader's own close (sentinel put), not
+        by our stop flag, so the reverse order would burn the full join
+        timeout on every slow-source shutdown."""
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        if self._owner is not None and hasattr(self._owner, "close"):
+            owner, self._owner = self._owner, None
+            owner.close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 def make_dataset(cfg: DataConfig, sharding=None,
                  label_sharding=None) -> Iterator:
     """Endless (or one-epoch, cfg.loop=False) iterator of device batches.
@@ -388,6 +527,12 @@ def make_dataset(cfg: DataConfig, sharding=None,
 
     With cfg.label_feature set, yields (images, labels) pairs; labels use
     `label_sharding` (required alongside `sharding` for labeled configs).
+
+    With cfg.prefetch_device_batches > 0 (the default) the returned
+    iterator is a DevicePrefetcher — a background thread keeps that many
+    sharded device batches queued ahead of the consumer; call `.close()`
+    (or exhaust it) to release the feed thread and the loader. 0 keeps the
+    legacy consumer-thread double buffer.
     """
     import jax
 
@@ -412,24 +557,27 @@ def make_dataset(cfg: DataConfig, sharding=None,
     labeled = bool(cfg.label_feature)
 
     if sharding is None:
-        yield from loader
-        return
+        return iter(loader)
     if labeled and label_sharding is None:
         raise ValueError("labeled dataset needs label_sharding")
+    if cfg.prefetch_device_batches > 0:
+        return DevicePrefetcher(
+            iter(loader), sharding, label_sharding,
+            depth=cfg.prefetch_device_batches,
+            num_classes=cfg.num_classes if labeled else 0,
+            owner=loader)
+    return _double_buffer(cfg, loader, sharding, label_sharding,
+                          labeled=labeled)
 
-    # double-buffer: keep one device transfer in flight ahead of the consumer
-    it = iter(loader)
+
+def _double_buffer(cfg: DataConfig, loader, sharding, label_sharding, *,
+                   labeled: bool) -> Iterator:
+    """Legacy consumer-thread feed (prefetch_device_batches=0): keep one
+    device transfer in flight ahead of the consumer."""
     pending = None
-    for batch in it:
+    for batch in iter(loader):
         if labeled and cfg.num_classes:
-            labels = batch[1]
-            bad = int(labels.max(initial=0))
-            if bad >= cfg.num_classes or int(labels.min(initial=0)) < 0:
-                raise ValueError(
-                    f"label {bad} out of range for num_classes="
-                    f"{cfg.num_classes} (dataset/config mismatch; on device "
-                    "this would silently one-hot to zeros or clamp the cBN "
-                    "table gather)")
+            _check_labels(batch, cfg.num_classes)
         nxt = to_global(batch, sharding, label_sharding)
         if pending is not None:
             yield pending

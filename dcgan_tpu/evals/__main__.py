@@ -185,6 +185,9 @@ def main(argv: Optional[List[str]] = None) -> None:
             distributed=args.multihost, real_cache_path=args.real_stats)
     except ValueError as e:
         raise SystemExit(str(e)) from None
+    finally:
+        if hasattr(data, "close"):  # stop the device-feed thread
+            data.close()
     result["step"] = step
     if jax.process_index() == 0:
         print(json.dumps(result))

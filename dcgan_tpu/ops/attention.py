@@ -320,10 +320,14 @@ def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
             if pallas_mesh is not None and \
                     pallas_mesh.shape.get(batch_axis, 1) > 1:
                 # data-parallel gspmd mesh: run the kernels per batch
-                # shard inside a nested shard_map (heads ride the batch
-                # dim batch-major, so a data-axis split keeps whole
-                # batches' head groups together). check_vma off: pallas
-                # outputs carry no vma annotations (same constraint as
+                # shard inside a nested shard_map. Heads ride the batch
+                # dim batch-major, so when B divides the data-axis size
+                # each shard holds whole batches' head groups — but
+                # correctness does NOT depend on that alignment: every
+                # [b, head] row is independent in flash_attention, so a
+                # split that lands mid-head-group is merely a layout, not
+                # a semantics, difference. check_vma off: pallas outputs
+                # carry no vma annotations (same constraint as
                 # ops/norm.py).
                 spec = P(batch_axis, None, None)
                 out = jax.shard_map(

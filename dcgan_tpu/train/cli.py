@@ -21,6 +21,17 @@ from typing import List, Optional
 from dcgan_tpu.config import TrainConfig
 
 
+def _parse_bool(s: str) -> bool:
+    """Explicit true/false flag values (--async_services=false); argparse's
+    bool() would treat any non-empty string, 'false' included, as True."""
+    low = s.strip().lower()
+    if low in ("true", "1", "yes", "on"):
+        return True
+    if low in ("false", "0", "no", "off"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected true/false, got {s!r}")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="dcgan_tpu.train",
@@ -115,11 +126,25 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--label_feature", default="label",
                    help="int64 class feature name in the records "
                         "(used when --num_classes > 0)")
+    p.add_argument("--prefetch_device_batches", type=int, default=2,
+                   help="depth of the background device-feed queue (a "
+                        "transfer thread keeps N sharded batches ready "
+                        "ahead of the dispatch thread); 0 = legacy inline "
+                        "double buffer")
     p.add_argument("--synthetic_device_cache", type=int, default=0,
                    help="with --synthetic: pre-stage N batches on device "
                         "and cycle them (loop-speed measurement; see "
                         "tools/bench_trainer_loop.py)")
     # observability / checkpoint (image_train.py:20-21,37,129)
+    p.add_argument("--async_services", type=_parse_bool, default=True,
+                   metavar="{true,false}",
+                   help="run observability (metric materialization, "
+                        "histograms, sample PNGs, event-file IO) on a "
+                        "background executor with lag-by-one metric "
+                        "logging; --async_services=false runs every "
+                        "service inline on the dispatch thread (the "
+                        "pre-async loop, identical metric values and "
+                        "event structure)")
     p.add_argument("--checkpoint_dir", default="checkpoint")
     p.add_argument("--sample_dir", default="samples")
     p.add_argument("--no_tensorboard", action="store_true",
@@ -214,7 +239,9 @@ _FLAG_FIELDS = {
     "sample_image_dir": ("", "sample_image_dir"),
     "record_dtype": ("", "record_dtype"),
     "label_feature": ("", "label_feature"),
+    "prefetch_device_batches": ("", "prefetch_device_batches"),
     "synthetic_device_cache": ("", "synthetic_device_cache"),
+    "async_services": ("", "async_services"),
     "checkpoint_dir": ("", "checkpoint_dir"), "sample_dir": ("", "sample_dir"),
     "save_summaries_secs": ("", "save_summaries_secs"),
     "save_model_secs": ("", "save_model_secs"),

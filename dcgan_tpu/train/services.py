@@ -1,0 +1,225 @@
+"""Async host services: the observability work the dispatch thread sheds.
+
+The hot loop's job is dispatching compiled step programs; every host-side
+service the reference ran inline — summary materialization, histogram
+reduction, PNG encode, event-file IO (image_train.py:155-192) — stalls
+dispatch for exactly its own duration. This module provides the trainer's
+background executor for that work (ISSUE 2 tentpole; the host-ahead-of-
+device discipline of pjit-era TPU trainers, arxiv 2204.06514, and
+ParaGAN's congestion-aware host pipeline, arxiv 2411.03999):
+
+- `HostServices`: ONE worker thread draining a bounded deque. Telemetry
+  must never stall training, so when the queue is full the OLDEST
+  droppable task is discarded (drop-oldest backpressure: the newest
+  telemetry is the most valuable, and a slow filesystem degrades
+  observability rather than throughput). Worker exceptions are captured
+  and re-raised on the dispatch thread at the next `raise_if_failed()` /
+  `drain()` — telemetry failures kill the job loudly, not silently.
+- `InlineServices`: the `--async_services=false` escape hatch. `submit`
+  executes the task immediately on the calling thread, reproducing the
+  pre-async trainer's synchronous behavior (same call sites, same
+  ordering, same metric values; the JSONL differs from pre-async builds
+  only by the perf/* occupancy keys StepTimer now always emits).
+
+Threading contract: the MetricWriter (JSONL + TensorBoard event files) is
+NOT thread-safe; in async mode every writer call must be submitted here so
+the single worker serializes them. Work that participates in mesh-wide
+collectives (the FID probe's all-gathers, Orbax collective saves, the
+pt.summarize/pt.sample dispatches themselves) must STAY on the dispatch
+thread: a collective issued from a per-process background thread has no
+ordering guarantee against the main thread's collectives, and two
+processes interleaving them differently deadlock the mesh. Only the
+host-local tails (device_get of already-dispatched outputs, reduction,
+encode, file IO) move here.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Optional
+
+# default queue bound: deep enough to absorb a burst (scalars + histograms
+# + grid + activations landing on one step), shallow enough that a wedged
+# filesystem drops telemetry within seconds instead of hoarding device-
+# array references
+DEFAULT_QUEUE_DEPTH = 16
+
+
+class ServiceError(RuntimeError):
+    """A background service task failed; carries the original traceback."""
+
+
+class _Task:
+    __slots__ = ("fn", "tag", "droppable")
+
+    def __init__(self, fn: Callable[[], None], tag: str, droppable: bool):
+        self.fn = fn
+        self.tag = tag
+        self.droppable = droppable
+
+
+class HostServices:
+    """Single-worker background executor with drop-oldest backpressure."""
+
+    def __init__(self, *, max_queue: int = DEFAULT_QUEUE_DEPTH,
+                 name: str = "dcgan-host-services"):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self.dropped = 0          # tasks discarded by backpressure
+        self.completed = 0
+        self._queue: "collections.deque[_Task]" = collections.deque()
+        self._lock = threading.Lock()
+        self._has_work = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)
+        self._busy = False        # worker currently executing a task
+        self._stop = False
+        self._error: Optional[BaseException] = None
+        self._error_tag = ""
+        self._worker = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._worker.start()
+
+    # -- worker side --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._stop:
+                    self._has_work.wait()
+                if self._stop and not self._queue:
+                    self._idle.notify_all()
+                    return
+                task = self._queue.popleft()
+                self._busy = True
+            try:
+                task.fn()
+                with self._lock:
+                    self.completed += 1
+            except BaseException as e:  # noqa: BLE001 — reported to main
+                with self._lock:
+                    if self._error is None:
+                        self._error = e
+                        self._error_tag = task.tag
+                    # a failed worker stops accepting work; pending tasks
+                    # are dropped so close()/drain() can't hang behind a
+                    # poisoned writer
+                    self._stop = True
+                    self._queue.clear()
+            finally:
+                with self._lock:
+                    self._busy = False
+                    self._idle.notify_all()
+
+    # -- dispatch-thread side -----------------------------------------------
+
+    def submit(self, fn: Callable[[], None], *, tag: str = "",
+               droppable: bool = True) -> bool:
+        """Enqueue `fn` for the worker; returns False if it was rejected
+        (executor stopped) or immediately displaced. When the queue is
+        full, the oldest droppable task is discarded to make room; if
+        nothing is droppable the NEW task blocks until space frees (never
+        silently lost — non-droppable is reserved for barrier-adjacent
+        work like final flushes)."""
+        with self._lock:
+            if self._stop:
+                return False
+            while len(self._queue) >= self.max_queue:
+                victim = next((t for t in self._queue if t.droppable), None)
+                if victim is not None:
+                    self._queue.remove(victim)
+                    self.dropped += 1
+                else:
+                    self._idle.wait(timeout=0.1)
+                    if self._stop:
+                        return False
+                    continue
+            self._queue.append(_Task(fn, tag, droppable))
+            self._has_work.notify()
+        return True
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._queue) + (1 if self._busy else 0)
+
+    def raise_if_failed(self) -> None:
+        """Propagate a worker failure to the calling (dispatch) thread."""
+        with self._lock:
+            err, tag = self._error, self._error_tag
+        if err is not None:
+            raise ServiceError(
+                f"background host service {tag or 'task'!r} failed: "
+                f"{err!r}") from err
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        """Barrier: block until every queued task has executed (or the
+        worker failed — which re-raises). Called at checkpoint boundaries
+        and on exit so telemetry ordered before a checkpoint is durable
+        before training proceeds past it."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while (self._queue or self._busy) and self._error is None:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"host-services drain timed out with "
+                        f"{len(self._queue)} task(s) pending")
+                self._idle.wait(timeout=remaining)
+        self.raise_if_failed()
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain then stop the worker. Safe to call twice. Re-raises a
+        worker failure (after the thread is down) so close-on-exception
+        paths still surface the original error."""
+        try:
+            self.drain(timeout=timeout)
+        except TimeoutError:
+            pass  # stop anyway; daemon thread cannot block interpreter exit
+        finally:
+            with self._lock:
+                self._stop = True
+                self._has_work.notify_all()
+            self._worker.join(timeout=timeout)
+        self.raise_if_failed()
+
+
+class InlineServices:
+    """Synchronous stand-in: `submit` runs the task on the calling thread.
+
+    The `--async_services=false` escape hatch: every service executes at
+    its original call site, in its original order, so the event stream
+    carries the same values and structure the inline trainer wrote.
+    Exceptions propagate immediately (no deferral)."""
+
+    max_queue = 0
+    dropped = 0
+    completed = 0
+
+    def submit(self, fn: Callable[[], None], *, tag: str = "",
+               droppable: bool = True) -> bool:
+        fn()
+        self.completed += 1
+        return True
+
+    def pending(self) -> int:
+        return 0
+
+    def raise_if_failed(self) -> None:
+        pass
+
+    def drain(self, timeout: Optional[float] = None) -> None:
+        pass
+
+    def close(self, timeout: float = 30.0) -> None:
+        pass
+
+
+def make_services(async_services: bool, *,
+                  max_queue: int = DEFAULT_QUEUE_DEPTH):
+    """The trainer's one switch between the async executor and the
+    inline escape hatch."""
+    return HostServices(max_queue=max_queue) if async_services \
+        else InlineServices()

@@ -18,6 +18,15 @@ per-step stdout log (160-169)               | per-step stdout log (chief)
 
 The loop is step-bounded (max_steps, reference :150) and restartable: state
 (params, BN stats, both Adam moments, step) round-trips through Orbax.
+
+Host-services layer (docs/DESIGN.md "Host services"): the dispatch thread's
+per-step work is pulling an already-transferred device batch from the
+background feed queue (data/pipeline.DevicePrefetcher) and dispatching the
+next compiled program; metric materialization runs lag-by-one (step N's
+scalars while step N+1 computes) and every expensive writer path — param/
+activation histograms, sample-grid PNGs, JSONL/TB IO — runs on the
+train/services.py background worker. `--async_services=false` restores the
+fully-inline loop.
 """
 
 from __future__ import annotations
@@ -39,6 +48,7 @@ from dcgan_tpu.parallel import (
     make_mesh,
     make_parallel_train,
 )
+from dcgan_tpu.train.services import make_services
 from dcgan_tpu.utils.checkpoint import Checkpointer
 from dcgan_tpu.utils.images import save_sample_grid
 from dcgan_tpu.utils.metrics import MetricWriter, param_histograms
@@ -58,39 +68,42 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
     conditional = cfg.model.num_classes > 0
     label_sharding = batch_sharding(mesh, 1) if conditional else None
     if synthetic:
-        def it():
-            # to_global needs this process's ADDRESSABLE BLOCK of the
-            # global batch (pipeline.process_local_box). The naive
-            # per-process slice (batch/process_count x full height) is that
-            # block only while each process's devices cover whole mesh
-            # rows; under a spatial mesh whose "model" axis spans
-            # processes, the block is a batch-slice x height-slice instead
-            # — and processes sharing a batch row MUST contribute
-            # height-slices of the SAME images. Seeding the stream by the
-            # block's BATCH OFFSET (not the process index) guarantees
-            # that: co-row processes draw identical full-height images and
-            # cut different height slices, while batch-disjoint processes
-            # draw distinct streams at 1/P of the global host cost.
-            # Single-process keeps the exact previous stream (offset 0,
-            # full box).
-            from dcgan_tpu.data.pipeline import process_local_box
+        # to_global needs this process's ADDRESSABLE BLOCK of the
+        # global batch (pipeline.process_local_box). The naive
+        # per-process slice (batch/process_count x full height) is that
+        # block only while each process's devices cover whole mesh
+        # rows; under a spatial mesh whose "model" axis spans
+        # processes, the block is a batch-slice x height-slice instead
+        # — and processes sharing a batch row MUST contribute
+        # height-slices of the SAME images. Seeding the stream by the
+        # block's BATCH OFFSET (not the process index) guarantees
+        # that: co-row processes draw identical full-height images and
+        # cut different height slices, while batch-disjoint processes
+        # draw distinct streams at 1/P of the global host cost.
+        # Single-process keeps the exact previous stream (offset 0,
+        # full box).
+        from dcgan_tpu.data.pipeline import (
+            DevicePrefetcher,
+            process_local_box,
+        )
 
-            size = cfg.model.output_size
-            box = process_local_box(
-                sharding, (cfg.batch_size, size, size, cfg.model.c_dim))
-            n_local = box[0].stop - box[0].start
-            src = synthetic_batches(
-                n_local, size, cfg.model.c_dim,
-                seed=cfg.seed + seed_offset + box[0].start,
-                num_classes=cfg.model.num_classes)
-            hwc = (box[1], box[2], box[3])
+        size = cfg.model.output_size
+        box = process_local_box(
+            sharding, (cfg.batch_size, size, size, cfg.model.c_dim))
+        n_local = box[0].stop - box[0].start
+        src = synthetic_batches(
+            n_local, size, cfg.model.c_dim,
+            seed=cfg.seed + seed_offset + box[0].start,
+            num_classes=cfg.model.num_classes)
+        hwc = (box[1], box[2], box[3])
 
-            def cut(batch):
-                if isinstance(batch, tuple):
-                    return batch[0][(slice(None),) + hwc], batch[1]
-                return batch[(slice(None),) + hwc]
+        def cut(batch):
+            if isinstance(batch, tuple):
+                return batch[0][(slice(None),) + hwc], batch[1]
+            return batch[(slice(None),) + hwc]
 
-            if cfg.synthetic_device_cache > 0:
+        if cfg.synthetic_device_cache > 0:
+            def it():
                 # pre-staged device pool, cycled forever: the loop consumes
                 # already-resident sharded arrays, so measurements see the
                 # trainer machinery, not the host->device transport
@@ -98,8 +111,18 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
                         for _ in range(cfg.synthetic_device_cache)]
                 while True:
                     yield from pool
-            for batch in src:
-                yield to_global(cut(batch), sharding, label_sharding)
+            return it()
+        host_batches = (cut(b) for b in src)
+        if cfg.prefetch_device_batches > 0:
+            # same background feed thread as the real-data path: synthetic
+            # batch generation + H2D transfer overlap device compute
+            # (labels, when present, are generated in-range — no gate)
+            return DevicePrefetcher(host_batches, sharding, label_sharding,
+                                    depth=cfg.prefetch_device_batches)
+
+        def it():
+            for batch in host_batches:
+                yield to_global(batch, sharding, label_sharding)
         return it()
     if jax.process_count() > 1:
         # The file-shard ownership model (process i owns shards i, i+P, ...)
@@ -151,7 +174,8 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
         seed=cfg.seed + seed_offset,
         normalize=cfg.normalize_inputs,
         label_feature=cfg.label_feature if conditional else "",
-        num_classes=cfg.model.num_classes if conditional else 0)
+        num_classes=cfg.model.num_classes if conditional else 0,
+        prefetch_device_batches=cfg.prefetch_device_batches)
     return make_dataset(dcfg, sharding, label_sharding)
 
 
@@ -403,256 +427,413 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                          start_step=start_step + cfg.profile_start_step,
                          num_steps=cfg.profile_num_steps)
 
+    # Async host services (train/services.py): every non-step host action —
+    # metric materialization, param/activation histograms, sample-grid PNG
+    # encode, JSONL/TB IO — runs on a single background worker so the
+    # dispatch thread's only per-step jobs are pulling a prefetched device
+    # batch and dispatching the next program. Mesh-wide collectives (the
+    # FID probe's all-gathers, Orbax collective saves, the pt.summarize/
+    # pt.sample dispatches themselves) stay HERE on the dispatch thread:
+    # collectives issued from per-process background threads have no
+    # cross-process ordering guarantee against this thread's and would
+    # deadlock the mesh. cfg.async_services=False degrades every submit()
+    # to an inline call at the same site — the pre-async loop structure,
+    # same metric values and event ordering.
+    svc = make_services(cfg.async_services)
+    deferred = cfg.async_services
+
+    def _stage(tree) -> None:
+        """Start D2H copies of a dispatched program's outputs now, so the
+        background worker's device_get finds them (mostly) materialized."""
+        for leaf in jax.tree_util.tree_leaves(tree):
+            leaf.copy_to_host_async()
+
+    _param_snap_fn = None
+
+    def _snapshot_params(params):
+        """A capture of `params` that survives the next step's buffer
+        donation, for the background histogram writer.
+
+        Single-process: a device-side copy — one async dispatch producing
+        fresh buffers (pt.step's donate_argnums only invalidates the
+        ORIGINAL leaves), which the worker device_gets while the next
+        steps run. Multi-process: a synchronous device_get on the dispatch
+        thread — the copy program would be a mesh-wide dispatch, and the
+        histogram tick is chief-only + wall-clock-gated, so dispatching it
+        from one process would wedge the other processes' collective
+        queues (same reason the FID probe stays on this thread); only the
+        histogram reduction + file IO move to the worker there."""
+        nonlocal _param_snap_fn
+        if deferred and n_proc == 1:
+            if _param_snap_fn is None:
+                _param_snap_fn = jax.jit(
+                    lambda t: jax.tree_util.tree_map(lambda a: a + 0, t))
+            snap = _param_snap_fn(params)
+            _stage(snap)
+            return snap
+        return jax.device_get(params)
+
+    def _host_vals(p: dict) -> dict:
+        """Materialized {name: float} metric scalars for one step's record,
+        cached on the record — ONE transfer shared by every consumer
+        (NaN gate, step log, summary writer); per-scalar float() would
+        issue a device round-trip each (~0.65 ms/step measured over a
+        high-latency transport, tools/bench_trainer_loop.py's 3.75 vs
+        3.09 ms/step gap)."""
+        if p.get("host") is None:
+            p["host"] = {k: float(v) for k, v in
+                         jax.device_get(p["metrics"]).items()}
+        return p["host"]
+
+    def _consume_metrics(p: dict) -> None:
+        """Host-side consumers of one step's replicated metric scalars:
+        numerical-health gate (SURVEY.md §5 — every process checks the
+        same replicated values, so a NaN/Inf kills the whole job in
+        unison with step context instead of silently training garbage or
+        deadlocking multi-host), stdout step log, and the time-throttled
+        scalar events. With async services this runs lag-by-one: step N's
+        scalars materialize while step N+1 runs on device, so the
+        blocking device_get overlaps compute instead of serializing the
+        pipeline; a NaN still aborts with the right step number, one step
+        later. All cadence math uses the record's own step, so
+        attribution is identical in both modes."""
+        s = p["step"]
+        if cfg.nan_check_steps and s % cfg.nan_check_steps == 0:
+            vals = _host_vals(p)
+            if not all(np.isfinite(v) for v in vals.values()):
+                raise FloatingPointError(
+                    f"non-finite training metrics at step {s}: "
+                    f"{vals} — inspect the last checkpoint in "
+                    f"{cfg.checkpoint_dir}")
+        if chief and cfg.log_every_steps and s % cfg.log_every_steps == 0:
+            m = _host_vals(p)
+            epoch = s * cfg.batch_size // epoch_size
+            print(f"[dcgan_tpu] epoch {epoch} step {s} "
+                  f"time {time.time() - t_start:.1f}s "
+                  f"d_loss {m['d_loss']:.4f} g_loss {m['g_loss']:.4f}")
+        if p["write_scalars"]:
+            row = {**_host_vals(p), **timer.summary()}
+            svc.submit(lambda: writer.write_scalars(s, row), tag="scalars")
+
+    # one step's metrics record awaiting its lag-by-one consumption
+    pending: Optional[dict] = None
+
     # step_num is tracked on the host (it equals state["step"], which the
     # trainer fully determines) — touching the device array every iteration
     # would force a per-step host sync and serialize the pipeline.
     epoch_size = max(1, _epoch_size(cfg))  # hoisted: reads the manifest once
     step_num = start_step
-    while step_num < total_steps:
-        if stop_signal["num"] is not None:
-            if chief:
-                print(f"[dcgan_tpu] received signal {stop_signal['num']} — "
-                      f"checkpointing at step {step_num} and exiting")
-            break
-        # steps_per_call > 1: dispatch K steps as one scanned program when
-        # aligned to a K boundary with K steps remaining (a checkpoint
-        # restore can land mid-boundary; single steps realign, and the
-        # tail below max_steps runs single too). Keys are per-step
-        # fold-ins, identical to the single-step path, so a run produces
-        # the same step keys whatever the call size.
-        k = cfg.steps_per_call
-        if not (k > 1 and step_num % k == 0 and step_num + k <= total_steps):
-            k = 1
-        trace.maybe_start(step_num)
-        labels = None
-        if k == 1:
-            key = jax.random.fold_in(base_key, step_num)
-            if conditional:
-                images, labels = next(data)
-                state, metrics = pt.step(state, images, key, labels)
-            else:
-                images = next(data)
-                state, metrics = pt.step(state, images, key)
-        else:
-            # one vmapped dispatch for all K per-step keys (a python loop of
-            # fold_ins would pay K of the per-dispatch overheads this path
-            # exists to shed); same per-step keys as the single-step path
-            keys = jax.vmap(jax.random.fold_in, (None, 0))(
-                base_key, jax.numpy.arange(step_num, step_num + k))
-            key = keys[-1]  # for the cadence consumers below (summaries)
-            if conditional:
-                pairs = [next(data) for _ in range(k)]
-                imgs_k = jax.numpy.stack([p[0] for p in pairs])
-                lbls_k = jax.numpy.stack([p[1] for p in pairs])
-                state, metrics = pt.multi_step(state, imgs_k, keys, lbls_k)
-                images, labels = pairs[-1]
-            else:
-                batches = [next(data) for _ in range(k)]
-                imgs_k = jax.numpy.stack(batches)
-                state, metrics = pt.multi_step(state, imgs_k, keys)
-                images = batches[-1]
-        new_step = step_num + k
-
-        # Numerical-health gate (SURVEY.md §5: the sanitizer-equivalent this
-        # design carries instead of the reference's race tolerance): every
-        # process checks the same replicated metrics, so a NaN/Inf kills the
-        # whole job in unison with step context instead of silently training
-        # garbage — or deadlocking multi-host if only one process bailed.
-        # Materialize ALL metric scalars in one transfer, once per
-        # iteration, shared by every host-side consumer below (NaN gate,
-        # step log, summary writer). Per-scalar float() here would issue
-        # one device round-trip EACH — measured ~0.65 ms/step of pure
-        # latency at a 500-step sync cadence over a high-latency transport
-        # (tools/bench_trainer_loop.py's 3.75 vs 3.09 ms/step gap).
-        metrics_host: Optional[dict] = None
-
-        def host_metrics() -> dict:
-            nonlocal metrics_host
-            if metrics_host is None:
-                metrics_host = {k: float(v) for k, v in
-                                jax.device_get(metrics).items()}
-            return metrics_host
-
-        if cfg.nan_check_steps and new_step % cfg.nan_check_steps == 0:
-            vals = host_metrics()
-            if not all(np.isfinite(v) for v in vals.values()):
-                raise FloatingPointError(
-                    f"non-finite training metrics at step {new_step}: "
-                    f"{vals} — inspect the last checkpoint in "
-                    f"{cfg.checkpoint_dir}")
-
-        if chief and cfg.log_every_steps and \
-                new_step % cfg.log_every_steps == 0:
-            m = host_metrics()
-            epoch = new_step * cfg.batch_size // epoch_size
-            print(f"[dcgan_tpu] epoch {epoch} step {new_step} "
-                  f"time {time.time() - t_start:.1f}s "
-                  f"d_loss {m['d_loss']:.4f} g_loss {m['g_loss']:.4f}")
-        # With per-step logging (the default, matching the reference's
-        # every-step stdout log) the float() sync above makes this true step
-        # latency; with log_every_steps=0 it measures dispatch cadence only.
-        timer.tick(steps=k)
-
-        if chief and writer.ready():
-            writer.write_scalars(new_step,
-                                 {**host_metrics(), **timer.summary()})
-            writer.write_histograms(
-                new_step, param_histograms(jax.device_get(state["params"])))
-
-        # per-layer activation histograms + sparsity (the reference's
-        # _activation_summary channel, distriubted_model.py:75-80). Runs on
-        # every process — it is a compiled mesh program — chief writes.
-        if cfg.activation_summary_steps and \
-                new_step % cfg.activation_summary_steps == 0:
-            acts = pt.summarize(state, images, jax.random.fold_in(key, 1),
-                                labels) if conditional else \
-                pt.summarize(state, images, jax.random.fold_in(key, 1))
-            if chief:
-                writer.write_activations(new_step, jax.device_get(acts))
-
-        if cfg.sample_every_steps and new_step % cfg.sample_every_steps == 0:
-            imgs = jax.device_get(pt.sample(state, sample_z, sample_labels)
-                                  if sample_labels is not None
-                                  else pt.sample(state, sample_z))
-            if chief:
-                path = os.path.join(cfg.sample_dir,
-                                    f"train_{new_step:08d}.png")
-                save_sample_grid(path, imgs[:rows * cols], (rows, cols))
-                writer.write_image_event(new_step, "samples", path)
-            # held-out loss probe on the sample pipeline's batch with the
-            # fixed z — the reference's sess.run([sampler, d_loss, g_loss])
-            # + print every 100 steps (image_train.py:179-192)
-            if sample_data is not None:
+    try:
+        while step_num < total_steps:
+            svc.raise_if_failed()  # a dead telemetry worker fails loudly
+            if stop_signal["num"] is not None:
+                if chief:
+                    print(f"[dcgan_tpu] received signal "
+                          f"{stop_signal['num']} — checkpointing at step "
+                          f"{step_num} and exiting")
+                break
+            # steps_per_call > 1: dispatch K steps as one scanned program
+            # when aligned to a K boundary with K steps remaining (a
+            # checkpoint restore can land mid-boundary; single steps
+            # realign, and the tail below max_steps runs single too). Keys
+            # are per-step fold-ins, identical to the single-step path, so
+            # a run produces the same step keys whatever the call size.
+            k = cfg.steps_per_call
+            if not (k > 1 and step_num % k == 0
+                    and step_num + k <= total_steps):
+                k = 1
+            trace.maybe_start(step_num)
+            labels = None
+            if k == 1:
+                key = jax.random.fold_in(base_key, step_num)
                 if conditional:
-                    s_imgs, s_labels = next(sample_data)
-                    ev = pt.eval_losses(state, s_imgs, eval_z, s_labels)
+                    images, labels = next(data)
+                    state, metrics = pt.step(state, images, key, labels)
                 else:
-                    s_imgs = next(sample_data)
-                    ev = pt.eval_losses(state, s_imgs, eval_z)
-                if chief:
-                    ev = {k: float(v) for k, v in ev.items()}
-                    print(f"[dcgan_tpu] [sample] step {new_step} "
-                          f"d_loss {ev['d_loss']:.8f} "
-                          f"g_loss {ev['g_loss']:.8f}")
-                    writer.write_scalars(
-                        new_step,
-                        {f"sample/{k}": v for k, v in ev.items()})
-
-        if cfg.fid_every_steps and new_step % cfg.fid_every_steps == 0:
-            from dcgan_tpu.evals.job import (
-                FeaturePool,
-                compute_fid,
-                stats_from_batches,
-            )
-
-            dist = n_proc > 1
-            if dist:
-                # Local sampler over the gathered generator tree: compiled
-                # once (weights are arguments, not closed-over constants),
-                # fed fresh weights each probe. Mirrors steps.py sample's
-                # EMA selection.
-                from jax.experimental import multihost_utils as mh
-
-                g_src = state["ema_gen"] if cfg.g_ema_decay > 0.0 \
-                    else state["params"]["gen"]
-                host_gen = jax.tree_util.tree_map(
-                    lambda x: mh.process_allgather(x, tiled=True),
-                    (g_src, state["bn"]["gen"]))
-                if fid_local_sampler is None:
-                    from dcgan_tpu.models import sampler_apply
-
-                    fid_local_sampler = jax.jit(
-                        lambda p, b, z, lbls=None: sampler_apply(
-                            p, b, z, cfg=cfg.model, labels=lbls))
-
-                def _sample_fn(z, lbls=None, _g=host_gen):
-                    return fid_local_sampler(_g[0], _g[1], z, lbls) \
-                        if lbls is not None \
-                        else fid_local_sampler(_g[0], _g[1], z)
+                    images = next(data)
+                    state, metrics = pt.step(state, images, key)
             else:
-                def _sample_fn(z, lbls=None, _s=state):
-                    return pt.sample(_s, z, lbls) if lbls is not None \
-                        else pt.sample(_s, z)
+                # one vmapped dispatch for all K per-step keys (a python
+                # loop of fold_ins would pay K of the per-dispatch
+                # overheads this path exists to shed); same per-step keys
+                # as the single-step path
+                keys = jax.vmap(jax.random.fold_in, (None, 0))(
+                    base_key, jax.numpy.arange(step_num, step_num + k))
+                key = keys[-1]  # for the cadence consumers below
+                if conditional:
+                    pairs = [next(data) for _ in range(k)]
+                    imgs_k = jax.numpy.stack([p[0] for p in pairs])
+                    lbls_k = jax.numpy.stack([p[1] for p in pairs])
+                    state, metrics = pt.multi_step(state, imgs_k, keys,
+                                                   lbls_k)
+                    images, labels = pairs[-1]
+                else:
+                    batches = [next(data) for _ in range(k)]
+                    imgs_k = jax.numpy.stack(batches)
+                    state, metrics = pt.multi_step(state, imgs_k, keys)
+                    images = batches[-1]
+            new_step = step_num + k
+            cur = {"step": new_step, "metrics": metrics,
+                   "write_scalars": False}
 
-            n = cfg.fid_num_samples
-            t_fid = time.time()
-            if fid_real_side is None:
-                # real-side statistics are computed ONCE, at the first
-                # probe: the held-out set is fixed, so re-streaming it each
-                # probe would double probe cost and add real-side sampling
-                # noise to the eval/fid trend. Multihost: each process
-                # streams its share, then the sides merge into one global
-                # real side (treated as already-global by compute_fid).
-                reals = (b[0] for b in fid_probe_data) if conditional \
-                    else fid_probe_data
-                r_pool = FeaturePool(fid_feature[1], n, seed=cfg.seed)
-                r_stats = stats_from_batches(fid_feature[0], reals,
-                                             n // n_proc,
-                                             fid_feature[1], pool=r_pool)
-                if dist:
-                    from dcgan_tpu.evals.job import (
-                        allgather_merge_pool,
-                        allgather_merge_stats,
-                    )
+            host_t0 = time.perf_counter()
+            if deferred:
+                # lag-by-one metric window: consume the PREVIOUS step's
+                # scalars now — its D2H copies have had a full step to
+                # land, so the materialization below reads cached values
+                # instead of blocking dispatch on the device — and start
+                # this step's copies for the next iteration.
+                if pending is not None:
+                    _consume_metrics(pending)
+                    pending = None
+                _stage(metrics)
+            else:
+                # inline escape hatch: NaN gate + step log at the original
+                # call site, synced to THIS step (true step latency)
+                _consume_metrics(cur)
+            timer.note_host(time.perf_counter() - host_t0)
+            # With per-step logging (the default, matching the reference's
+            # every-step stdout log) each tick follows one metric
+            # materialization — true step latency, lagged by one step in
+            # async mode; with log_every_steps=0 it measures dispatch
+            # cadence only.
+            timer.tick(steps=k)
 
-                    r_stats = allgather_merge_stats(r_stats)
-                    r_pool = allgather_merge_pool(r_pool)
-                fid_real_side = (r_stats, r_pool)
-            fid_result = compute_fid(
-                _sample_fn, None, image_size=cfg.model.output_size,
-                c_dim=cfg.model.c_dim, z_dim=cfg.model.z_dim,
-                num_samples=n, batch_size=cfg.batch_size,
-                num_classes=cfg.model.num_classes, seed=cfg.seed,
-                feature_fn=fid_feature[0], feature_dim=fid_feature[1],
-                kid=True, kid_subset_size=max(2, min(1000, n // 4)),
-                kid_subsets=20, kid_pool_size=n,
-                distributed=dist, real_side=fid_real_side)
-            if chief:
-                print(f"[dcgan_tpu] [fid] step {new_step} "
-                      f"fid {fid_result['fid']:.6f} "
-                      f"kid {fid_result['kid']:.3e} "
-                      f"({n} samples, {time.time() - t_fid:.1f}s)")
-                writer.write_scalars(new_step, {
-                    "eval/fid": fid_result["fid"],
-                    "eval/kid": fid_result["kid"],
-                })
-            # best-checkpoint retention: when the probe improves on the
-            # best FID seen this run, snapshot into checkpoint_dir/best
-            # (its own manager, max_to_keep=1) — training ends with both
-            # the latest state AND the best-scoring one on disk. The
-            # periodic/latest cadence is untouched. Multihost: the gathered
-            # score is identical on every process, so every process takes
-            # this branch together and the Orbax save stays a valid
-            # collective; only the chief touches score.json/config.json.
-            if fid_result["fid"] < fid_best:
-                import json
+            host_t0 = time.perf_counter()
+            if chief and writer.ready():
+                if deferred:
+                    cur["write_scalars"] = True  # written at the next flush
+                else:
+                    row = {**_host_vals(cur), **timer.summary()}
+                    svc.submit(lambda s=new_step, r=row:
+                               writer.write_scalars(s, r), tag="scalars")
+                snap = _snapshot_params(state["params"])
+                svc.submit(lambda s=new_step, t=snap:
+                           writer.write_histograms(s, param_histograms(t)),
+                           tag="histograms")
+            if deferred:
+                pending = cur
 
-                fid_best = fid_result["fid"]
-                best_dir = os.path.join(cfg.checkpoint_dir, "best")
-                if best_ckpt is None:
-                    # sync save: each best-save is final before training
-                    # continues, so async machinery would only be joined
-                    best_ckpt = Checkpointer(best_dir, max_to_keep=1,
-                                             async_save=False)
-                    # its own config.json so `generate --checkpoint_dir
-                    # ckpt/best` works zero-flag like any checkpoint dir
-                    if chief:
-                        save_config(cfg, best_dir)
-                best_ckpt.save(new_step, state, force=True)
+            # per-layer activation histograms + sparsity (the reference's
+            # _activation_summary channel, distriubted_model.py:75-80). The
+            # summarize DISPATCH runs on every process — it is a compiled
+            # mesh program — only the chief's device_get + write moves to
+            # the worker (the outputs are fresh replicated arrays; nothing
+            # donates them).
+            if cfg.activation_summary_steps and \
+                    new_step % cfg.activation_summary_steps == 0:
+                acts = pt.summarize(state, images,
+                                    jax.random.fold_in(key, 1),
+                                    labels) if conditional else \
+                    pt.summarize(state, images, jax.random.fold_in(key, 1))
                 if chief:
-                    # persisted score: resume re-seeds fid_best from this
-                    tmp = os.path.join(best_dir, "score.json.tmp")
-                    with open(tmp, "w") as f:
-                        json.dump({"fid": fid_best, "step": int(new_step)},
-                                  f)
-                    os.replace(tmp, os.path.join(best_dir, "score.json"))
-                    print(f"[dcgan_tpu] [fid] new best ({fid_best:.6f}) — "
-                          f"saved {cfg.checkpoint_dir}/best/{new_step}")
+                    _stage(acts)
+                    svc.submit(lambda s=new_step, a=acts:
+                               writer.write_activations(s,
+                                                        jax.device_get(a)),
+                               tag="activations")
 
-        trace.maybe_stop(new_step, sync=metrics)
-        ckpt.maybe_save(new_step, state)
-        step_num = new_step
+            if cfg.sample_every_steps and \
+                    new_step % cfg.sample_every_steps == 0:
+                imgs_dev = pt.sample(state, sample_z, sample_labels) \
+                    if sample_labels is not None \
+                    else pt.sample(state, sample_z)
+                if chief:
+                    _stage(imgs_dev)
+                    path = os.path.join(cfg.sample_dir,
+                                        f"train_{new_step:08d}.png")
 
+                    def _grid_task(s=new_step, a=imgs_dev, p=path):
+                        imgs = jax.device_get(a)
+                        save_sample_grid(p, imgs[:rows * cols], (rows, cols))
+                        writer.write_image_event(s, "samples", p)
+                    svc.submit(_grid_task, tag="sample-grid")
+                # held-out loss probe on the sample pipeline's batch with
+                # the fixed z — the reference's sess.run([sampler, d_loss,
+                # g_loss]) + print every 100 steps (image_train.py:179-192)
+                if sample_data is not None:
+                    if conditional:
+                        s_imgs, s_labels = next(sample_data)
+                        ev = pt.eval_losses(state, s_imgs, eval_z, s_labels)
+                    else:
+                        s_imgs = next(sample_data)
+                        ev = pt.eval_losses(state, s_imgs, eval_z)
+                    if chief:
+                        _stage(ev)
+
+                        def _probe_task(s=new_step, e=ev):
+                            vals = {k: float(v) for k, v in
+                                    jax.device_get(e).items()}
+                            print(f"[dcgan_tpu] [sample] step {s} "
+                                  f"d_loss {vals['d_loss']:.8f} "
+                                  f"g_loss {vals['g_loss']:.8f}")
+                            writer.write_scalars(
+                                s, {f"sample/{k}": v
+                                    for k, v in vals.items()})
+                        svc.submit(_probe_task, tag="sample-probe")
+            timer.note_host(time.perf_counter() - host_t0)
+
+            # The in-training FID/KID probe stays ENTIRELY on the dispatch
+            # thread: its real-side streaming, feature all-gathers, and
+            # the best-checkpoint Orbax save are mesh-wide collectives,
+            # and collectives issued from a background thread have no
+            # cross-process ordering against this thread's step dispatches
+            # — two processes interleaving them differently deadlocks the
+            # mesh. Only the two result scalars go through the writer
+            # queue (the writer itself is single-threaded).
+            if cfg.fid_every_steps and new_step % cfg.fid_every_steps == 0:
+                from dcgan_tpu.evals.job import (
+                    FeaturePool,
+                    compute_fid,
+                    stats_from_batches,
+                )
+
+                dist = n_proc > 1
+                if dist:
+                    # Local sampler over the gathered generator tree:
+                    # compiled once (weights are arguments, not closed-over
+                    # constants), fed fresh weights each probe. Mirrors
+                    # steps.py sample's EMA selection.
+                    from jax.experimental import multihost_utils as mh
+
+                    g_src = state["ema_gen"] if cfg.g_ema_decay > 0.0 \
+                        else state["params"]["gen"]
+                    host_gen = jax.tree_util.tree_map(
+                        lambda x: mh.process_allgather(x, tiled=True),
+                        (g_src, state["bn"]["gen"]))
+                    if fid_local_sampler is None:
+                        from dcgan_tpu.models import sampler_apply
+
+                        fid_local_sampler = jax.jit(
+                            lambda p, b, z, lbls=None: sampler_apply(
+                                p, b, z, cfg=cfg.model, labels=lbls))
+
+                    def _sample_fn(z, lbls=None, _g=host_gen):
+                        return fid_local_sampler(_g[0], _g[1], z, lbls) \
+                            if lbls is not None \
+                            else fid_local_sampler(_g[0], _g[1], z)
+                else:
+                    def _sample_fn(z, lbls=None, _s=state):
+                        return pt.sample(_s, z, lbls) if lbls is not None \
+                            else pt.sample(_s, z)
+
+                n = cfg.fid_num_samples
+                t_fid = time.time()
+                if fid_real_side is None:
+                    # real-side statistics are computed ONCE, at the first
+                    # probe: the held-out set is fixed, so re-streaming it
+                    # each probe would double probe cost and add real-side
+                    # sampling noise to the eval/fid trend. Multihost: each
+                    # process streams its share, then the sides merge into
+                    # one global real side (treated as already-global by
+                    # compute_fid).
+                    reals = (b[0] for b in fid_probe_data) if conditional \
+                        else fid_probe_data
+                    r_pool = FeaturePool(fid_feature[1], n, seed=cfg.seed)
+                    r_stats = stats_from_batches(fid_feature[0], reals,
+                                                 n // n_proc,
+                                                 fid_feature[1], pool=r_pool)
+                    if dist:
+                        from dcgan_tpu.evals.job import (
+                            allgather_merge_pool,
+                            allgather_merge_stats,
+                        )
+
+                        r_stats = allgather_merge_stats(r_stats)
+                        r_pool = allgather_merge_pool(r_pool)
+                    fid_real_side = (r_stats, r_pool)
+                fid_result = compute_fid(
+                    _sample_fn, None, image_size=cfg.model.output_size,
+                    c_dim=cfg.model.c_dim, z_dim=cfg.model.z_dim,
+                    num_samples=n, batch_size=cfg.batch_size,
+                    num_classes=cfg.model.num_classes, seed=cfg.seed,
+                    feature_fn=fid_feature[0], feature_dim=fid_feature[1],
+                    kid=True, kid_subset_size=max(2, min(1000, n // 4)),
+                    kid_subsets=20, kid_pool_size=n,
+                    distributed=dist, real_side=fid_real_side)
+                if chief:
+                    print(f"[dcgan_tpu] [fid] step {new_step} "
+                          f"fid {fid_result['fid']:.6f} "
+                          f"kid {fid_result['kid']:.3e} "
+                          f"({n} samples, {time.time() - t_fid:.1f}s)")
+                    svc.submit(lambda s=new_step, r=dict(
+                        fid_result): writer.write_scalars(s, {
+                            "eval/fid": r["fid"],
+                            "eval/kid": r["kid"],
+                        }), tag="fid-scalars")
+                # best-checkpoint retention: when the probe improves on the
+                # best FID seen this run, snapshot into checkpoint_dir/best
+                # (its own manager, max_to_keep=1) — training ends with
+                # both the latest state AND the best-scoring one on disk.
+                # The periodic/latest cadence is untouched. Multihost: the
+                # gathered score is identical on every process, so every
+                # process takes this branch together and the Orbax save
+                # stays a valid collective; only the chief touches
+                # score.json/config.json.
+                if fid_result["fid"] < fid_best:
+                    import json
+
+                    fid_best = fid_result["fid"]
+                    best_dir = os.path.join(cfg.checkpoint_dir, "best")
+                    if best_ckpt is None:
+                        # sync save: each best-save is final before
+                        # training continues, so async machinery would
+                        # only be joined
+                        best_ckpt = Checkpointer(best_dir, max_to_keep=1,
+                                                 async_save=False)
+                        # its own config.json so `generate
+                        # --checkpoint_dir ckpt/best` works zero-flag like
+                        # any checkpoint dir
+                        if chief:
+                            save_config(cfg, best_dir)
+                    best_ckpt.save(new_step, state, force=True)
+                    if chief:
+                        # persisted score: resume re-seeds fid_best from
+                        # this
+                        tmp = os.path.join(best_dir, "score.json.tmp")
+                        with open(tmp, "w") as f:
+                            json.dump({"fid": fid_best,
+                                       "step": int(new_step)}, f)
+                        os.replace(tmp,
+                                   os.path.join(best_dir, "score.json"))
+                        print(f"[dcgan_tpu] [fid] new best "
+                              f"({fid_best:.6f}) — saved "
+                              f"{cfg.checkpoint_dir}/best/{new_step}")
+
+            trace.maybe_stop(new_step, sync=metrics)
+            if ckpt.maybe_save(new_step, state):
+                # drain-on-checkpoint barrier: every telemetry event
+                # submitted before this checkpoint is durable before
+                # training proceeds past it — a preemption right after a
+                # save cannot lose events older than the checkpoint
+                svc.drain()
+            step_num = new_step
+
+        # final lag-by-one flush: the last step's NaN gate / log / scalars
+        # (fires before the final forced save below, so a NaN in the last
+        # step still aborts the run rather than being checkpointed quietly)
+        if pending is not None:
+            _consume_metrics(pending)
+            pending = None
+        if chief:
+            svc.submit(writer.flush, tag="tb-flush", droppable=False)
+        svc.close()  # drain-on-exit barrier; re-raises worker failures
+        if chief and getattr(svc, "dropped", 0):
+            print(f"[dcgan_tpu] host-services backpressure dropped "
+                  f"{svc.dropped} telemetry event(s) (training was never "
+                  f"stalled for them; raise the queue bound or slow the "
+                  f"summary cadence to keep them all)")
+    finally:
+        # clean shutdown on EVERY exit path (normal, signal break, NaN
+        # abort, loader error): stop the device-feed threads and the
+        # services worker without masking an in-flight exception
+        for closing in (svc, data, sample_data, fid_probe_data):
+            if closing is None or not hasattr(closing, "close"):
+                continue
+            try:
+                closing.close()
+            except Exception:
+                pass
     trace.close()
     writer.close()
     # final forced save at the step actually reached (== total_steps unless

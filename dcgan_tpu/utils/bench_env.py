@@ -48,18 +48,32 @@ def bench_model_config(env=None) -> Tuple[ModelConfig, str]:
         label = f"sagan{size}-attn"
     else:
         label = "headline" if size == 64 else f"dcgan{size}"
+    # BENCH_ATTN_RES is applied to the CONFIG later (apply_attn_res_override
+    # runs on the full TrainConfig), but the label must reflect it NOW
+    # (ADVICE r5 #2): the flash/pallas suffix below keys off whether
+    # attention actually runs, and computing it pre-override mislabeled
+    # e.g. BENCH_ATTN_RES=128 + BENCH_PALLAS=1 + BENCH_BN_PALLAS=0 as
+    # '-pallas-xlabn' (declared "no Pallas kernel runs") though it runs
+    # flash attention. The bench matrix's long-context rows name these
+    # '<family>-attn<R>-{flash,dense}' (tools/capture_all.py) — match that.
+    attn_res_knob = int(env.get("BENCH_ATTN_RES", "0") or 0)
+    if attn_res_knob:
+        label += f"-attn{attn_res_knob}"
+    effective_attn = mcfg.attn_res or attn_res_knob
     if mcfg.use_pallas:
         # "-flash" = flash attention with BN split back to XLA (the
         # measured-best form); "-pallas" = both kernel families engaged;
         # "-pallas-xlabn" = the degenerate no-attention + BN-split combo
         # (no Pallas kernel actually runs — kept distinct so it can never
         # merge with the fused-BN row)
-        if mcfg.attn_res and mcfg.bn_pallas is False:
+        if effective_attn and mcfg.bn_pallas is False:
             label += "-flash"
         elif mcfg.bn_pallas is False:
             label += "-pallas-xlabn"
         else:
             label += "-pallas"
+    elif attn_res_knob:
+        label += "-dense"  # the bench matrix's explicit dense rows
     if mcfg.spectral_norm != "none":
         label += "-sn"
     return mcfg, label

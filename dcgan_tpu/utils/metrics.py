@@ -105,6 +105,13 @@ class MetricWriter:
     scalars, per-layer histograms + sparsity scalars, and sample-grid images
     render in the same dashboards the reference's TF summaries did
     (image_train.py:86-118).
+
+    Threading contract: NOT thread-safe — the JSONL append and the TB
+    writer's internal buffer both assume one caller at a time. The trainer
+    honors this by routing every write through one thread: the services
+    executor's single worker in async mode (train/services.py), the
+    dispatch thread itself with --async_services=false. `ready()` stays on
+    the dispatch thread in both modes (it only reads the clock).
     """
 
     def __init__(self, logdir: str, *, every_secs: float = 10.0,
@@ -201,6 +208,12 @@ class MetricWriter:
         if self._tb and os.path.exists(path):
             with open(path, "rb") as f:
                 self._tb.add_image_png(name, f.read(), step)
+            self._tb.flush()
+
+    def flush(self) -> None:
+        """Force buffered TB state to disk (JSONL writes are already
+        per-event durable); the services drain barrier's final task."""
+        if self._tb:
             self._tb.flush()
 
     def close(self) -> None:

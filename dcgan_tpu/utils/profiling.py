@@ -19,6 +19,18 @@ trainer's per-step metric logging (float() on the loss scalars) provides that
 sync, so the timer measures true steady-state step latency including data-feed
 time — which is the point: a rising step time with constant device time is the
 input-bound signature (the reference's own pathology, SURVEY.md §2.4 #10).
+With async_services (the default) the sync is lag-by-one — step N's metrics
+materialize while step N+1 runs — so each tick still follows exactly one
+device-progress point per step; steady-state rates are unchanged, only the
+attribution of an individual slow step can shift by one tick.
+
+`note_host` feeds the dispatch-thread occupancy channel: the trainer stamps
+the wall time its dispatch thread spends executing host-side service work
+(metric materialization, submissions, inline writers) per loop iteration, and
+summary() reports it as perf/host_ms_mean plus perf/dispatch_occupancy (the
+fraction of step time the dispatch thread is busy with non-dispatch work —
+the number the async services layer exists to drive toward zero;
+tools/bench_trainer_loop.py's occupancy mode records it on/off).
 """
 
 from __future__ import annotations
@@ -36,6 +48,8 @@ class StepTimer:
         self.window = window
         self.images_per_step = images_per_step
         self._durations: collections.deque = collections.deque(maxlen=window)
+        self._host: collections.deque = collections.deque(maxlen=window)
+        self._host_pending = 0.0
         self._last: Optional[float] = None
 
     def tick(self, now: Optional[float] = None, steps: int = 1) -> None:
@@ -44,9 +58,17 @@ class StepTimer:
         now = time.perf_counter() if now is None else now
         if self._last is not None:
             per_step = (now - self._last) / max(1, steps)
+            host_per_step = self._host_pending / max(1, steps)
             for _ in range(max(1, steps)):
                 self._durations.append(per_step)
+                self._host.append(host_per_step)
+        self._host_pending = 0.0
         self._last = now
+
+    def note_host(self, seconds: float) -> None:
+        """Accumulate dispatch-thread host-work time attributed to the
+        steps of the NEXT tick (call any number of times per iteration)."""
+        self._host_pending += seconds
 
     def __len__(self) -> int:
         return len(self._durations)
@@ -67,6 +89,11 @@ class StepTimer:
         }
         if self.images_per_step and mean > 0:
             out[f"{prefix}images_per_sec"] = self.images_per_step / mean
+        if self._host:
+            host_mean = sum(self._host) / len(self._host)
+            out[f"{prefix}host_ms_mean"] = 1e3 * host_mean
+            out[f"{prefix}dispatch_occupancy"] = \
+                host_mean / mean if mean > 0 else 0.0
         return out
 
 

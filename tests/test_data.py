@@ -12,6 +12,7 @@ from dcgan_tpu.data import tfrecord
 from dcgan_tpu.data.example_proto import parse_example, serialize_example
 from dcgan_tpu.data.pipeline import (
     DataConfig,
+    DevicePrefetcher,
     PythonLoader,
     list_shards,
     make_dataset,
@@ -508,3 +509,132 @@ class TestManifestAdoption:
                          min_after_dequeue=4)
         with pytest.raises(ValueError, match="record_dtype"):
             next(iter(make_dataset(bad)))
+
+
+class TestDevicePrefetcher:
+    """The background device-feed queue (ISSUE 2 tentpole): depth bound,
+    ordering, mid-epoch shutdown, and producer-error propagation."""
+
+    @staticmethod
+    def _sharding():
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dcgan_tpu.parallel import make_mesh
+        return NamedSharding(make_mesh(), P("data", None, None, None))
+
+    @staticmethod
+    def _host_batches(n, size=8):
+        rng = np.random.default_rng(0)
+        return [rng.uniform(-1, 1, (16, size, size, 3)).astype(np.float32)
+                for _ in range(n)]
+
+    def test_ordering_and_delivery(self):
+        sh = self._sharding()
+        batches = self._host_batches(6)
+        pf = DevicePrefetcher(iter(batches), sh, depth=2)
+        out = list(pf)
+        assert len(out) == 6
+        for host, dev in zip(batches, out):
+            assert dev.sharding == sh
+            np.testing.assert_array_equal(np.asarray(dev), host)
+        pf.close()
+
+    def test_depth_bounds_producer_runahead(self):
+        """With a stalled consumer the producer parks at depth batches
+        queued (+1 in flight) — the queue is bounded, not a hoard of
+        device memory."""
+        import itertools
+        import time as _time
+
+        sh = self._sharding()
+        produced = itertools.count()
+        count = {"n": 0}
+
+        def host_iter():
+            for b in self._host_batches(50):
+                count["n"] = next(produced) + 1
+                yield b
+
+        pf = DevicePrefetcher(host_iter(), sh, depth=3)
+        deadline = _time.time() + 5.0
+        while count["n"] < 4 and _time.time() < deadline:
+            _time.sleep(0.01)
+        _time.sleep(0.3)  # give an unbounded producer time to run away
+        assert count["n"] <= 3 + 2  # depth queued + one assembling + slack
+        first = next(pf)
+        assert first.shape == (16, 8, 8, 3)
+        pf.close()
+
+    def test_mid_epoch_close_stops_producer(self):
+        closed = {"owner": False}
+
+        class Owner:
+            def close(self):
+                closed["owner"] = True
+
+        sh = self._sharding()
+        pf = DevicePrefetcher(iter(self._host_batches(50)), sh, depth=2,
+                              owner=Owner())
+        next(pf)  # mid-epoch
+        pf.close()
+        assert closed["owner"]
+        assert not pf._thread.is_alive()
+        with pytest.raises(StopIteration):
+            next(pf)
+        pf.close()  # idempotent
+
+    def test_producer_error_propagates_with_type(self):
+        sh = self._sharding()
+
+        def bad_iter():
+            yield self._host_batches(1)[0]
+            raise ValueError("decode exploded")
+
+        pf = DevicePrefetcher(bad_iter(), sh, depth=2)
+        next(pf)
+        with pytest.raises(ValueError, match="decode exploded"):
+            while True:
+                next(pf)
+
+    def test_label_gate_runs_on_producer_thread(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dcgan_tpu.parallel import make_mesh
+
+        mesh = make_mesh()
+        sh = NamedSharding(mesh, P("data", None, None, None))
+        lsh = NamedSharding(mesh, P("data"))
+        imgs = self._host_batches(1)[0]
+        labels = np.asarray([7] * 16, dtype=np.int32)  # >= num_classes
+        pf = DevicePrefetcher(iter([(imgs, labels)]), sh, lsh, depth=2,
+                              num_classes=4)
+        with pytest.raises(ValueError, match="out of range"):
+            next(pf)
+
+    def test_make_dataset_returns_prefetcher_and_legacy_path(self, tmp_path):
+        _write_dataset(tmp_path)
+        sh = self._sharding()
+        cfg = DataConfig(data_dir=str(tmp_path / "data"), image_size=8,
+                         batch_size=16, min_after_dequeue=8, n_threads=2,
+                         prefetch_device_batches=2)
+        it = make_dataset(cfg, sh)
+        assert isinstance(it, DevicePrefetcher)
+        b = next(it)
+        assert b.shape == (16, 8, 8, 3) and b.sharding == sh
+        it.close()
+        legacy = DataConfig(data_dir=str(tmp_path / "data"), image_size=8,
+                            batch_size=16, min_after_dequeue=8, n_threads=2,
+                            prefetch_device_batches=0)
+        it2 = make_dataset(legacy, sh)
+        assert not isinstance(it2, DevicePrefetcher)
+        b2 = next(it2)
+        assert b2.shape == (16, 8, 8, 3) and b2.sharding == sh
+
+    def test_one_epoch_drains_to_stop_iteration(self, tmp_path):
+        _write_dataset(tmp_path, n=32, shards=2)
+        sh = self._sharding()
+        cfg = DataConfig(data_dir=str(tmp_path / "data"), image_size=8,
+                         batch_size=16, min_after_dequeue=8, n_threads=2,
+                         loop=False, prefetch_device_batches=2)
+        out = list(make_dataset(cfg, sh))
+        assert len(out) == 2  # 32 examples / 16 per batch, no repeat

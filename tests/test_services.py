@@ -1,0 +1,214 @@
+"""Host-services executor (train/services.py): ordering, drop-oldest
+backpressure, error propagation to the dispatch thread, drain barriers, and
+the inline escape hatch — plus the trainer-level contracts: lag-by-one NaN
+attribution and async/inline metrics-JSONL equivalence (ISSUE 2)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from dcgan_tpu.train.services import (
+    HostServices,
+    InlineServices,
+    ServiceError,
+    make_services,
+)
+
+
+class TestHostServices:
+    def test_tasks_run_in_order(self):
+        svc = HostServices()
+        seen = []
+        for i in range(10):
+            svc.submit(lambda i=i: seen.append(i))
+        svc.drain()
+        assert seen == list(range(10))
+        assert svc.completed == 10 and svc.dropped == 0
+        svc.close()
+
+    def test_drop_oldest_backpressure(self):
+        """A full queue discards the OLDEST droppable task — training (the
+        submitter) never blocks on telemetry."""
+        svc = HostServices(max_queue=4)
+        gate = threading.Event()
+        done = []
+        svc.submit(gate.wait, droppable=False)  # wedge the worker
+        deadline = time.monotonic() + 5.0
+        while svc.pending() > 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        for i in range(10):    # 4-deep queue: only the newest survive
+            svc.submit(lambda i=i: done.append(i))
+        assert svc.dropped > 0
+        gate.set()
+        svc.drain()
+        # the survivors are the most recent submissions, still in order
+        assert done == sorted(done)
+        assert done[-1] == 9 and len(done) <= 4
+        svc.close()
+
+    def test_non_droppable_never_dropped(self):
+        svc = HostServices(max_queue=2)
+        gate = threading.Event()
+        done = []
+        svc.submit(gate.wait, droppable=False)
+        # wait for the worker to pick the wedge up so it never occupies a
+        # queue slot the assertions below reason about
+        deadline = time.monotonic() + 5.0
+        while svc.pending() > 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        svc.submit(lambda: done.append("keep1"), droppable=False)
+        svc.submit(lambda: done.append("keep2"), droppable=False)
+        # a third non-droppable submit must wait for space, so release the
+        # worker from another thread shortly
+        threading.Timer(0.2, gate.set).start()
+        svc.submit(lambda: done.append("keep3"), droppable=False)
+        svc.drain()
+        assert done == ["keep1", "keep2", "keep3"]
+        assert svc.dropped == 0
+        svc.close()
+
+    def test_worker_error_propagates_to_dispatch_thread(self):
+        svc = HostServices()
+        svc.submit(lambda: (_ for _ in ()).throw(OSError("disk full")),
+                   tag="scalars")
+        deadline = time.monotonic() + 5.0
+        with pytest.raises(ServiceError, match="scalars"):
+            while time.monotonic() < deadline:
+                svc.raise_if_failed()
+                time.sleep(0.01)
+        # a failed executor refuses further work instead of hiding it
+        assert svc.submit(lambda: None) is False
+        with pytest.raises(ServiceError):
+            svc.drain()
+
+    def test_drain_is_a_barrier(self):
+        svc = HostServices()
+        done = []
+        svc.submit(lambda: (time.sleep(0.2), done.append(1)))
+        svc.drain()
+        assert done == [1]  # not merely queued: executed
+        svc.close()
+
+    def test_close_idempotent(self):
+        svc = HostServices()
+        svc.submit(lambda: None)
+        svc.close()
+        svc.close()
+        assert svc.submit(lambda: None) is False
+
+    def test_factory(self):
+        assert isinstance(make_services(True), HostServices)
+        assert isinstance(make_services(False), InlineServices)
+
+    def test_inline_runs_immediately_on_caller(self):
+        svc = InlineServices()
+        tid = []
+        svc.submit(lambda: tid.append(threading.get_ident()))
+        assert tid == [threading.get_ident()]  # same thread, already done
+        with pytest.raises(RuntimeError):
+            svc.submit(lambda: (_ for _ in ()).throw(RuntimeError("now")))
+
+
+@pytest.mark.slow
+class TestTrainerServiceContracts:
+    """The trainer-level behaviors the executor exists for, on the real
+    loop (JAX_PLATFORMS=cpu via conftest)."""
+
+    def _cfg(self, tmp_path, **kw):
+        from dcgan_tpu.config import ModelConfig, TrainConfig
+
+        base = dict(
+            model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                              compute_dtype="float32"),
+            batch_size=16,
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            sample_dir=str(tmp_path / "samples"),
+            sample_grid=(2, 2),
+            sample_size=4,
+            sample_every_steps=3,
+            save_summaries_secs=0.0,   # every loop check fires
+            save_model_secs=1e9,       # only the final forced save
+            log_every_steps=0)
+        base.update(kw)
+        return TrainConfig(**base)
+
+    def test_lag_by_one_nan_gate_attribution(self, tmp_path):
+        """Async mode materializes step N's metrics during step N+1, but a
+        NaN must still abort naming step N — the record carries its own
+        step, not the loop's current one."""
+        from dcgan_tpu.train.trainer import train
+
+        cfg = self._cfg(tmp_path, sample_every_steps=0,
+                        learning_rate=float("nan"), nan_check_steps=1,
+                        async_services=True)
+        with pytest.raises(FloatingPointError, match="step 1"):
+            train(cfg, synthetic_data=True, max_steps=5)
+
+    def test_final_step_nan_still_gated(self, tmp_path):
+        """The lag-by-one window flushes after the loop: a NaN in the very
+        last step cannot slip out un-gated."""
+        from dcgan_tpu.train.trainer import train
+
+        cfg = self._cfg(tmp_path, sample_every_steps=0,
+                        learning_rate=float("nan"), nan_check_steps=1,
+                        async_services=True)
+        with pytest.raises(FloatingPointError, match="step 1"):
+            train(cfg, synthetic_data=True, max_steps=1)
+
+    def test_async_and_inline_write_identical_metric_values(self, tmp_path):
+        """--async_services=false is the escape hatch: same seed, same
+        steps -> the deterministic event content (kinds, steps, metric
+        values) matches the async run's; only wall-clock fields (`time`,
+        perf/*) may differ."""
+        from dcgan_tpu.train.trainer import train
+
+        def run(sub, async_services):
+            cfg = self._cfg(tmp_path / sub, activation_summary_steps=5,
+                            async_services=async_services)
+            train(cfg, synthetic_data=True, max_steps=7)
+            events = [json.loads(line) for line in
+                      open(tmp_path / sub / "ckpt" / "events.jsonl")]
+            cleaned = []
+            for e in events:
+                e.pop("time", None)
+                if e["kind"] == "scalars":
+                    e["values"] = {k: v for k, v in e["values"].items()
+                                   if not k.startswith("perf/")}
+                if e["kind"] == "image":
+                    import os
+                    e["path"] = os.path.basename(e["path"])
+                cleaned.append(e)
+            # the async writer may interleave event ORDER across kinds
+            # (scalars lag one step); compare kind-keyed sorted streams
+            return sorted(cleaned, key=lambda e: (e["kind"], e["step"],
+                                                  json.dumps(e,
+                                                             sort_keys=True)))
+
+        a = run("async", True)
+        b = run("inline", False)
+        assert a == b
+
+    def test_drain_on_checkpoint(self, tmp_path, monkeypatch):
+        """A periodic checkpoint save forces the telemetry queue empty —
+        events ordered before the checkpoint are durable before training
+        proceeds past it."""
+        from dcgan_tpu.train import trainer as trainer_mod
+        from dcgan_tpu.train import services as services_mod
+
+        drained = []
+        orig_drain = services_mod.HostServices.drain
+
+        def spy_drain(self, timeout=None):
+            drained.append(self.pending())
+            return orig_drain(self, timeout)
+
+        monkeypatch.setattr(services_mod.HostServices, "drain", spy_drain)
+        cfg = self._cfg(tmp_path, sample_every_steps=0,
+                        save_model_secs=0.0,  # every maybe_save fires
+                        async_services=True)
+        trainer_mod.train(cfg, synthetic_data=True, max_steps=3)
+        # one drain per periodic save + the exit barrier; after each the
+        # queue really is empty
+        assert len(drained) >= 3

@@ -315,3 +315,36 @@ class TestToolsRunOnCpu:
         summ = lines[-1]
         assert summ["label"] == "step-profile"
         assert summ["step_ms"] > 0 and summ["fwd_ms"] > 0
+
+
+class TestBenchEnvLabels:
+    """bench_model_config's label is the join key between capture rows and
+    step_profile rooflines; it must reflect the attention that actually
+    runs AFTER the BENCH_ATTN_RES override (ADVICE r5 #2)."""
+
+    def _label(self, **env):
+        from dcgan_tpu.utils.bench_env import bench_model_config
+        return bench_model_config(env)[1]
+
+    def test_base_labels_unchanged(self):
+        assert self._label() == "headline"
+        assert self._label(BENCH_SIZE="128") == "dcgan128"
+        assert self._label(BENCH_ATTN="1") == "sagan64-attn"
+        assert self._label(BENCH_ATTN="1", BENCH_PALLAS="1",
+                           BENCH_BN_PALLAS="0") == "sagan64-attn-flash"
+        assert self._label(BENCH_PALLAS="1") == "headline-pallas"
+        assert self._label(BENCH_PALLAS="1", BENCH_BN_PALLAS="0") \
+            == "headline-pallas-xlabn"
+        assert self._label(BENCH_ATTN="1", BENCH_SN="1") \
+            == "sagan64-attn-sn"
+
+    def test_attn_res_override_labels_match_bench_matrix(self):
+        """The ADVICE r5 #2 scenario: a BENCH_ATTN_RES config running flash
+        attention must not be labeled '-pallas-xlabn' (declared
+        no-Pallas-kernel-runs); long-context labels match capture_all's
+        '<family>-attn<R>-{flash,dense}' naming."""
+        assert self._label(BENCH_SIZE="256", BENCH_ATTN_RES="128",
+                           BENCH_PALLAS="1", BENCH_BN_PALLAS="0") \
+            == "dcgan256-attn128-flash"
+        assert self._label(BENCH_SIZE="256", BENCH_ATTN_RES="128") \
+            == "dcgan256-attn128-dense"

@@ -170,7 +170,11 @@ def main() -> None:
                           n_threads=cfg.num_loader_threads,
                           seed=0, normalize=True)
         data = make_dataset(dcfg, batch_sharding(mesh, 4))
-        rate, state = measure(data, f"real {dtype}", state)
+        try:
+            rate, state = measure(data, f"real {dtype}", state)
+        finally:
+            if hasattr(data, "close"):  # stop the device-feed thread
+                data.close()
         print(json.dumps({
             "metric": f"{args.preset} train throughput "
                       f"(batch {args.batch}/chip)",

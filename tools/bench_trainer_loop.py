@@ -20,6 +20,16 @@ Prints one JSON line:
   {"label": "trainer-loop", "images_per_sec_chip": R, "window_steps": [a,b],
    "ms_per_step": t, ...}
 
+TRAINER_BENCH_OCCUPANCY=1 switches to the host-services A/B mode (ISSUE 2):
+the same trainer runs twice — --async_services=true then =false — with
+per-step logging and frequent summary ticks enabled (the observability
+regime the async layer exists for), and the row reports each run's
+perf/dispatch_occupancy and perf/step_ms_mean from its own metrics JSONL,
+so the dispatch-thread overlap win is a recorded number, not a claim:
+  {"label": "trainer-loop-occupancy",
+   "services_on":  {"dispatch_occupancy": ..., "step_ms_mean": ...},
+   "services_off": {"dispatch_occupancy": ..., "step_ms_mean": ...}, ...}
+
 Workload anchor: the hot loop being replaced, image_train.py:147-194.
 """
 
@@ -39,6 +49,73 @@ SCAN = int(os.environ.get("TRAINER_BENCH_SCAN", 50))
 WARMUP_STEPS = int(os.environ.get("TRAINER_BENCH_WARMUP", 1000))
 
 LOG_RE = re.compile(r"\[dcgan_tpu\] epoch \d+ step (\d+) time ([0-9.]+)s")
+
+
+def _occupancy_mode() -> None:
+    """A/B the async host-services layer under an observability-heavy
+    regime and report recorded dispatch-thread occupancy for both arms."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    steps = int(os.environ.get("TRAINER_BENCH_STEPS", 300))
+    batch = os.environ.get("BENCH_BATCH", "64")
+    row = {"label": "trainer-loop-occupancy", "batch": int(batch),
+           "total_steps": steps}
+    for arm, async_flag in (("services_on", "true"),
+                            ("services_off", "false")):
+        with tempfile.TemporaryDirectory() as tmp:
+            ckpt = os.path.join(tmp, "ckpt")
+            argv = [
+                sys.executable, "-m", "dcgan_tpu.train",
+                "--synthetic",
+                "--synthetic_device_cache",
+                os.environ.get("TRAINER_BENCH_CACHE", "8"),
+                "--max_steps", str(steps),
+                "--batch_size", batch,
+                "--async_services", async_flag,
+                # the observability regime the async layer targets:
+                # per-step logging (the reference's contract) + a summary
+                # tick (scalars AND full param histograms) every ~2 s
+                "--log_every_steps",
+                os.environ.get("TRAINER_BENCH_LOG", "1"),
+                "--nan_check_steps", "100",
+                "--save_summaries_secs",
+                os.environ.get("TRAINER_BENCH_SUMMARY_SECS", "2"),
+                "--sample_every_steps", "0",
+                "--activation_summary_steps", "0",
+                "--save_model_secs", "1e9",
+                "--no_tensorboard",
+                "--checkpoint_dir", ckpt,
+                "--sample_dir", os.path.join(tmp, "samples"),
+            ]
+            res = subprocess.run(
+                argv, cwd=repo, capture_output=True, text=True,
+                timeout=float(os.environ.get("TRAINER_BENCH_TIMEOUT", 900)))
+            if res.returncode != 0:
+                print(json.dumps({**row, "error":
+                                  f"{arm} trainer rc={res.returncode}",
+                                  "stderr_tail": (res.stderr or "")[-300:]}))
+                sys.exit(1)
+            # last perf summary of the run = steady state (the sliding
+            # window has long since shed warmup/compile iterations)
+            perf = None
+            with open(os.path.join(ckpt, "events.jsonl")) as f:
+                for line in f:
+                    e = json.loads(line)
+                    if e["kind"] == "scalars" and \
+                            "perf/dispatch_occupancy" in e["values"]:
+                        perf = e["values"]
+            if perf is None:
+                print(json.dumps({**row, "error":
+                                  f"{arm}: no perf scalars in events.jsonl"}))
+                sys.exit(1)
+            row[arm] = {
+                "dispatch_occupancy":
+                    round(perf["perf/dispatch_occupancy"], 4),
+                "host_ms_mean": round(perf["perf/host_ms_mean"], 3),
+                "step_ms_mean": round(perf["perf/step_ms_mean"], 2),
+                "images_per_sec": round(perf.get("perf/images_per_sec", 0.0),
+                                        1),
+            }
+    print(json.dumps(row))
 
 
 def main() -> None:
@@ -105,4 +182,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("TRAINER_BENCH_OCCUPANCY") == "1":
+        _occupancy_mode()
+    else:
+        main()

@@ -145,11 +145,19 @@ def main(argv=None) -> None:
                            seed=args.seed, normalize=True, **wire),
                 batch_sharding(mesh, 4))
 
-        result = compute_fid(
-            sample_fn, data, image_size=mcfg.output_size, c_dim=mcfg.c_dim,
-            z_dim=mcfg.z_dim, num_samples=args.num_samples,
-            batch_size=args.batch_size, num_classes=mcfg.num_classes,
-            seed=args.seed, kid=args.kid)
+        try:
+            result = compute_fid(
+                sample_fn, data, image_size=mcfg.output_size,
+                c_dim=mcfg.c_dim,
+                z_dim=mcfg.z_dim, num_samples=args.num_samples,
+                batch_size=args.batch_size, num_classes=mcfg.num_classes,
+                seed=args.seed, kid=args.kid)
+        finally:
+            # a fresh pipeline is built per checkpoint: release its feed
+            # thread + queued device batches instead of accreting one per
+            # scored step
+            if hasattr(data, "close"):
+                data.close()
         row = {"step": target, "fid": result["fid"]}
         if args.kid:
             row["kid"] = result["kid"]

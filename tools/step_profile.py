@@ -91,7 +91,10 @@ def main() -> None:
         mcfg, profile_of = bench_model_config()
         cfg = TrainConfig(model=mcfg, batch_size=BATCH)
     cfg = apply_attn_res_override(cfg)
-    if os.environ.get("BENCH_ATTN_RES"):
+    if preset_name and os.environ.get("BENCH_ATTN_RES"):
+        # non-preset labels already carry the attn/flash/dense naming from
+        # bench_model_config (computed post-override, ADVICE r5 #2); preset
+        # labels only need the attn_res marker appended
         profile_of += f"-attn{os.environ['BENCH_ATTN_RES']}"
     if cfg.model.num_classes:
         raise SystemExit(
