@@ -374,6 +374,33 @@ class TrainConfig:
                                    # step context if not (0 = off) — the
                                    # numerical-health hook SURVEY.md §5 names
                                    # as this design's sanitizer equivalent
+    nan_policy: str = "abort"      # what a tripped NaN gate does: "abort"
+                                   # (reference parity: raise with step
+                                   # context) | "rollback" (fail-operational:
+                                   # restore the last-good host snapshot,
+                                   # skip the offending batch window, keep
+                                   # training — train/rollback.py;
+                                   # single-process runs only)
+    rollback_snapshot_steps: int = 100  # nan_policy="rollback": keep a host-
+                                   # side copy of the last gate-verified
+                                   # state every K steps (the restore point;
+                                   # one device_get of the full state per K
+                                   # steps)
+    max_rollbacks: int = 3         # rollbacks allowed per run before the
+                                   # gate aborts anyway — persistent
+                                   # divergence must still fail loudly, not
+                                   # loop forever
+    rollback_lr_backoff: float = 1.0  # <1.0: multiply both nets' base
+                                   # learning rates by this on every
+                                   # rollback (rebuilds the compiled step —
+                                   # a recompile per rollback, acceptable
+                                   # for a rare recovery event); 1.0 = off
+    max_corrupt_records: int = 0   # >0: data-pipeline CRC/parse failures
+                                   # quarantine the record (skip + log
+                                   # file/offset + data/corrupt_records
+                                   # counter) up to this many before hard-
+                                   # failing; 0 = first corrupt record is
+                                   # fatal (reference parity)
     activation_summary_steps: int = 500  # per-layer activation histogram +
                                          # sparsity cadence (0 = off). Step-
                                          # gated, not time-gated: the summary
@@ -470,6 +497,30 @@ class TrainConfig:
                 f"warmup_steps ({self.warmup_steps}) must be < max_steps "
                 f"({self.max_steps}) — the whole run would be warmup and the "
                 "decay schedule would never engage")
+        if self.nan_policy not in ("abort", "rollback"):
+            raise ValueError(
+                f"nan_policy must be 'abort' or 'rollback', got "
+                f"{self.nan_policy!r}")
+        if self.nan_policy == "rollback" and not self.nan_check_steps:
+            raise ValueError(
+                "nan_policy='rollback' needs the NaN gate enabled "
+                "(nan_check_steps > 0) — with the gate off nothing ever "
+                "trips, so the snapshot cost buys no protection")
+        if self.rollback_snapshot_steps < 1:
+            raise ValueError(
+                f"rollback_snapshot_steps must be >= 1, got "
+                f"{self.rollback_snapshot_steps}")
+        if self.max_rollbacks < 1:
+            raise ValueError(
+                f"max_rollbacks must be >= 1, got {self.max_rollbacks}")
+        if not 0.0 < self.rollback_lr_backoff <= 1.0:
+            raise ValueError(
+                f"rollback_lr_backoff must be in (0, 1], got "
+                f"{self.rollback_lr_backoff}")
+        if self.max_corrupt_records < 0:
+            raise ValueError(
+                f"max_corrupt_records must be >= 0, got "
+                f"{self.max_corrupt_records}")
         if self.steps_per_call < 1:
             raise ValueError(
                 f"steps_per_call must be >= 1, got {self.steps_per_call}")
@@ -482,6 +533,12 @@ class TrainConfig:
                 "save_model_steps": self.save_model_steps,
                 "fid_every_steps": self.fid_every_steps,
             }
+            if self.nan_policy == "rollback":
+                # the snapshot cadence is inert under the default policy —
+                # its (default 100) value must not constrain steps_per_call
+                # for runs that never arm rollback
+                cadences["rollback_snapshot_steps"] = \
+                    self.rollback_snapshot_steps
             # A cadence that is a multiple of K fires exactly on schedule; a
             # cadence that divides K fires at every call boundary (e.g. the
             # default per-step log becomes one line per call, reporting the

@@ -7,6 +7,7 @@ with a C++ reader/shuffler/batcher feeding sharded jax.Arrays directly, with
 prefetch so the TPU never waits on the host.
 """
 
+from dcgan_tpu.data import quarantine  # noqa: F401
 from dcgan_tpu.data.pipeline import (  # noqa: F401
     DataConfig,
     make_dataset,

@@ -62,13 +62,16 @@ def _get_lib():
                 ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
                 ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
                 ctypes.c_int, ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
-                ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p]
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_longlong]
             lib.dcgan_loader_next.restype = ctypes.c_int
             lib.dcgan_loader_next.argtypes = [ctypes.c_void_p,
                                               ctypes.POINTER(ctypes.c_float),
                                               ctypes.POINTER(ctypes.c_int32)]
             lib.dcgan_loader_error.restype = ctypes.c_char_p
             lib.dcgan_loader_error.argtypes = [ctypes.c_void_p]
+            lib.dcgan_loader_corrupt_count.restype = ctypes.c_longlong
+            lib.dcgan_loader_corrupt_count.argtypes = [ctypes.c_void_p]
             lib.dcgan_loader_destroy.restype = None
             lib.dcgan_loader_destroy.argtypes = [ctypes.c_void_p]
             _lib = lib
@@ -87,7 +90,7 @@ class NativeLoader:
                  prefetch_batches: int = 4, seed: int = 0,
                  normalize: bool = True, verify_crc: bool = True,
                  loop: bool = True, feature_name: str = "image_raw",
-                 label_feature: str = ""):
+                 label_feature: str = "", max_corrupt_records: int = 0):
         if record_dtype not in _DTYPE_CODES:
             raise ValueError(f"record_dtype must be one of {list(_DTYPE_CODES)}")
         for p in paths:
@@ -99,6 +102,8 @@ class NativeLoader:
         self.batch = int(batch)
         self.example_shape = tuple(int(d) for d in example_shape)
         self.labeled = bool(label_feature)
+        self._corrupt_synced = 0   # native count already mirrored into the
+        #                            process-wide quarantine tally
         n_floats = int(np.prod(self.example_shape))
         c_paths = (ctypes.c_char_p * len(paths))(
             *[p.encode() for p in paths])
@@ -107,13 +112,32 @@ class NativeLoader:
             _DTYPE_CODES[record_dtype], int(min_after_dequeue),
             int(n_threads), int(prefetch_batches), int(seed),
             int(bool(normalize)), int(bool(verify_crc)), int(bool(loop)),
-            feature_name.encode(), label_feature.encode())
+            feature_name.encode(), label_feature.encode(),
+            int(max_corrupt_records))
         if not self._handle:
             raise NativeLoaderError("loader_create failed")
         self._out = np.empty((self.batch,) + self.example_shape,
                              dtype=np.float32)
         self._out_labels = (np.empty((self.batch,), dtype=np.int32)
                             if self.labeled else None)
+
+    @property
+    def corrupt_records(self) -> int:
+        """Records the native loader has quarantined so far."""
+        if not getattr(self, "_handle", None):
+            return self._corrupt_synced
+        return int(self._lib.dcgan_loader_corrupt_count(self._handle))
+
+    def _sync_corrupt_count(self) -> None:
+        """Mirror the native quarantine count into the process-wide tally
+        (data/quarantine.py) so the trainer's data/corrupt_records scalar
+        covers both loader implementations."""
+        n = self.corrupt_records
+        if n > self._corrupt_synced:
+            from dcgan_tpu.data import quarantine
+
+            quarantine.add(n - self._corrupt_synced)
+            self._corrupt_synced = n
 
     def next(self):
         """Next float32 [B, ...] batch — or an ([B, ...], int32 [B]) pair for
@@ -123,6 +147,7 @@ class NativeLoader:
             self._out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             self._out_labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
             if self.labeled else None)
+        self._sync_corrupt_count()
         if rc == 0:
             if self.labeled:
                 return self._out.copy(), self._out_labels.copy()
@@ -141,6 +166,10 @@ class NativeLoader:
 
     def close(self):
         if getattr(self, "_handle", None):
+            try:
+                self._sync_corrupt_count()
+            except Exception:
+                pass
             self._lib.dcgan_loader_destroy(self._handle)
             self._handle = None
 

@@ -27,8 +27,10 @@
 #include <deque>
 #include <mutex>
 #include <random>
+#include <set>
 #include <stdio.h>
 #include <string>
+#include <utility>
 #include <thread>
 #include <vector>
 
@@ -327,6 +329,9 @@ struct LoaderConfig {
   uint64_t seed = 0;
   bool normalize = true;          // x/127.5 - 1
   bool verify_crc = true;
+  int64_t max_corrupt = 0;        // >0: quarantine (skip + count) up to this
+                                  // many corrupt records before failing the
+                                  // stream; 0 = fail-fast (seed behavior)
   std::string feature_name = "image_raw";
   std::string label_feature;      // non-empty: also read an int64 label per
                                   // example (the feature the reference's
@@ -416,11 +421,46 @@ class Loader {
     return error_.c_str();
   }
 
+  int64_t corrupt_count() const { return corrupt_count_.load(); }
+
  private:
   void Fail(const std::string& msg) {
     std::lock_guard<std::mutex> lk(mu_);
     if (error_.empty()) error_ = msg;
     batch_cv_.notify_all();
+  }
+
+  // Corrupt-record quarantine (--max_corrupt_records): true = the record is
+  // counted and the caller skips what it safely can; false = quarantine is
+  // off (seed fail-fast) or the budget is exhausted — the stream is failed
+  // and the caller must stop. The file+offset log line is what the operator
+  // repairs from. Looping datasets re-encounter the same bad record every
+  // epoch: repeats are skipped silently (counted and logged once), so the
+  // budget bounds DISTINCT corrupt records, not epochs survived.
+  bool Quarantine(const std::string& what, const std::string& path,
+                  long offset) {
+    if (cfg_.max_corrupt <= 0) {
+      // fail-fast (seed behavior): the record is not quarantined, so it
+      // does not count as one
+      Fail(what + " in " + path);
+      return false;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!quarantined_.insert({path, offset}).second) return true;
+    }
+    int64_t seen = ++corrupt_count_;
+    if (seen > cfg_.max_corrupt) {
+      Fail(what + " in " + path + " (corrupt-record budget " +
+           std::to_string(cfg_.max_corrupt) + " exhausted)");
+      return false;
+    }
+    fprintf(stderr,
+            "[dcgan_loader] quarantined corrupt record: %s (%s @ byte %ld; "
+            "%lld/%lld of budget)\n",
+            what.c_str(), path.c_str(), offset, (long long)seen,
+            (long long)cfg_.max_corrupt);
+    return true;
   }
 
   bool DecodeExample(Slice payload, std::vector<float>* out) {
@@ -487,70 +527,74 @@ class Loader {
           Fail("cannot open shard: " + cfg_.paths[fi]);
           return;
         }
+        // Per-record failure routing: a data-CRC/parse failure quarantines
+        // just that record (framing intact — skip and continue); a length-
+        // CRC mismatch or short read leaves no trusted resync point, so the
+        // rest of the file is abandoned. Quarantine() returning false means
+        // the stream has been failed (budget off or exhausted): stop.
+        bool give_up = false;       // stream failed — thread exits
         uint8_t header[12];
-        while (fread(header, 1, 12, f) == 12) {
+        long rec_off;
+        while (rec_off = ftell(f), fread(header, 1, 12, f) == 12) {
           uint64_t len;
           memcpy(&len, header, 8);
           if (cfg_.verify_crc) {
             uint32_t lcrc;
             memcpy(&lcrc, header + 8, 4);
             if (masked_crc32c(header, 8) != lcrc) {
-              Fail("length CRC mismatch in " + cfg_.paths[fi]);
-              fclose(f);
-              return;
+              give_up = !Quarantine("length CRC mismatch", cfg_.paths[fi],
+                                    rec_off);
+              break;  // length untrusted: abandon the rest of this file
             }
           }
           buf.resize(len + 4);
           if (fread(buf.data(), 1, len + 4, f) != len + 4) {
-            Fail("truncated record in " + cfg_.paths[fi]);
-            fclose(f);
-            return;
+            give_up = !Quarantine("truncated record", cfg_.paths[fi],
+                                  rec_off);
+            break;
           }
           if (cfg_.verify_crc) {
             uint32_t dcrc;
             memcpy(&dcrc, buf.data() + len, 4);
             if (masked_crc32c(buf.data(), len) != dcrc) {
-              Fail("data CRC mismatch in " + cfg_.paths[fi]);
-              fclose(f);
-              return;
+              if (Quarantine("data CRC mismatch", cfg_.paths[fi], rec_off))
+                continue;  // framing intact: skip just this record
+              give_up = true;
+              break;
             }
           }
           Slice features;
-          if (!get_features({buf.data(), size_t(len)}, &features)) {
-            Fail("malformed Example in " + cfg_.paths[fi]);
-            fclose(f);
-            return;
-          }
           Slice payload;
-          if (!extract_bytes_feature(features, cfg_.feature_name, &payload)) {
-            Fail("record missing feature '" + cfg_.feature_name + "' in " +
-                 cfg_.paths[fi]);
-            fclose(f);
-            return;
-          }
           std::vector<float> ex;
-          if (!DecodeExample(payload, &ex)) {
-            Fail("bad example payload size in " + cfg_.paths[fi]);
-            fclose(f);
-            return;
-          }
-          if (cfg_.labeled()) {
+          std::string why;
+          if (!get_features({buf.data(), size_t(len)}, &features)) {
+            why = "malformed Example";
+          } else if (!extract_bytes_feature(features, cfg_.feature_name,
+                                            &payload)) {
+            why = "record missing feature '" + cfg_.feature_name + "'";
+          } else if (!DecodeExample(payload, &ex)) {
+            why = "bad example payload size";
+          } else if (cfg_.labeled()) {
             int64_t label = 0;
-            if (!extract_int64_feature(features, cfg_.label_feature, &label)) {
-              Fail("record missing int64 feature '" + cfg_.label_feature +
-                   "' in " + cfg_.paths[fi]);
-              fclose(f);
-              return;
+            if (!extract_int64_feature(features, cfg_.label_feature,
+                                       &label)) {
+              why = "record missing int64 feature '" + cfg_.label_feature +
+                    "'";
+            } else if (label < 0 || label > (int64_t(1) << 24)) {
+              // labels ride a float32 pool slot; beyond 2^24 that
+              // representation is lossy, so reject rather than silently
+              // corrupt class ids
+              why = "label " + std::to_string(label) +
+                    " out of range [0, 2^24]";
+            } else {
+              ex[cfg_.example_floats] = float(label);
             }
-            // labels ride a float32 pool slot; beyond 2^24 that representation
-            // is lossy, so reject rather than silently corrupt class ids
-            if (label < 0 || label > (int64_t(1) << 24)) {
-              Fail("label " + std::to_string(label) + " out of range [0, 2^24]"
-                   " in " + cfg_.paths[fi]);
-              fclose(f);
-              return;
-            }
-            ex[cfg_.example_floats] = float(label);
+          }
+          if (!why.empty()) {
+            if (Quarantine(why, cfg_.paths[fi], rec_off))
+              continue;  // skip just this record
+            give_up = true;
+            break;
           }
           read_any = true;
           PushExample(std::move(ex));
@@ -560,6 +604,7 @@ class Loader {
           }
         }
         fclose(f);
+        if (give_up) return;
       }
       if (first_pass && !read_any && tid == 0 && cfg_.paths.empty()) {
         Fail("no shards given");
@@ -628,6 +673,8 @@ class Loader {
   std::vector<std::vector<float>> pool_;
   std::deque<std::vector<float>> batches_;
   std::string error_;
+  std::atomic<int64_t> corrupt_count_{0};
+  std::set<std::pair<std::string, long>> quarantined_;  // (shard, offset)
   bool stop_ = false;
   bool done_ = false;
   bool batching_ = false;   // batcher holds picked examples not yet published
@@ -652,7 +699,7 @@ void* dcgan_loader_create(const char** paths, int n_paths, int batch,
                           int min_after_dequeue, int n_threads,
                           int prefetch_batches, uint64_t seed, int normalize,
                           int verify_crc, int loop, const char* feature_name,
-                          const char* label_feature) {
+                          const char* label_feature, long long max_corrupt) {
   LoaderConfig cfg;
   for (int i = 0; i < n_paths; ++i) cfg.paths.emplace_back(paths[i]);
   cfg.batch = batch;
@@ -667,6 +714,7 @@ void* dcgan_loader_create(const char** paths, int n_paths, int batch,
   cfg.loop = loop != 0;
   if (feature_name) cfg.feature_name = feature_name;
   if (label_feature) cfg.label_feature = label_feature;
+  cfg.max_corrupt = int64_t(max_corrupt);
   return new Loader(std::move(cfg));
 }
 
@@ -678,6 +726,12 @@ int dcgan_loader_next(void* handle, float* out, int32_t* out_labels) {
 
 const char* dcgan_loader_error(void* handle) {
   return static_cast<Loader*>(handle)->error();
+}
+
+// Records quarantined (skipped) so far under max_corrupt > 0; also counts
+// the final budget-exhausting record once the stream has failed.
+long long dcgan_loader_corrupt_count(void* handle) {
+  return static_cast<Loader*>(handle)->corrupt_count();
 }
 
 void dcgan_loader_destroy(void* handle) {

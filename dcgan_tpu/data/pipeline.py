@@ -20,6 +20,7 @@ import json
 import os
 import queue
 import random
+import struct
 import threading
 from typing import Iterator, List, Optional, Sequence
 
@@ -64,6 +65,15 @@ class DataConfig:
                                     # different ways (one_hot -> zeros; the
                                     # cBN table gather -> clamped index), so
                                     # the pipeline is where it must be caught
+    max_corrupt_records: int = 0    # >0: CRC/parse failures QUARANTINE the
+                                    # record (skip + log file/offset + count,
+                                    # data/quarantine.py) up to this many
+                                    # before hard-failing; 0 = any corrupt
+                                    # record is fatal (seed behavior). The
+                                    # pure-Python loader verifies CRCs only
+                                    # when quarantine is on (detection needs
+                                    # verification; the native loader always
+                                    # verifies, in hardware)
     use_native: bool = True         # C++ loader; False = pure-Python fallback
     loop: bool = True
 
@@ -208,7 +218,8 @@ class PythonLoader:
                  min_after_dequeue: int = 1024, n_threads: int = 4,
                  prefetch_batches: int = 4, seed: int = 0,
                  normalize: bool = True, loop: bool = True,
-                 feature_name: str = "image_raw", label_feature: str = ""):
+                 feature_name: str = "image_raw", label_feature: str = "",
+                 verify_crc: bool = False, max_corrupt_records: int = 0):
         self.batch = batch
         self.example_shape = tuple(example_shape)
         self.labeled = bool(label_feature)
@@ -223,6 +234,10 @@ class PythonLoader:
         self._feature = feature_name
         self._label_feature = label_feature
         self._rng = random.Random(seed)
+        self._verify_crc = verify_crc
+        self._max_corrupt = max_corrupt_records
+        self._corrupt = 0            # DISTINCT records quarantined
+        self._quarantined: set = set()   # (path, offset) already counted
         self._pool: List[np.ndarray] = []
         self._pool_lock = threading.Condition()
         self._batches: "queue.Queue" = queue.Queue(maxsize=prefetch_batches)
@@ -250,30 +265,75 @@ class PythonLoader:
             x = x / 127.5 - 1.0
         return x
 
+    @property
+    def corrupt_records(self) -> int:
+        """Records this loader has quarantined so far."""
+        return self._corrupt
+
+    def _quarantine(self, path: str, offset: int, reason: str) -> None:
+        """Count one skipped record; raises CorruptRecordError past the
+        budget (data/quarantine.py owns the log line and the process-wide
+        tally the trainer surfaces as data/corrupt_records). A looping
+        dataset re-encounters the same bad record every epoch — repeats are
+        skipped silently, so the budget bounds DISTINCT corrupt records,
+        not epochs survived."""
+        from dcgan_tpu.data import quarantine
+
+        with self._pool_lock:
+            if (path, offset) in self._quarantined:
+                return
+            self._quarantined.add((path, offset))
+            self._corrupt += 1
+            seen = self._corrupt
+        quarantine.record(path, offset, reason,
+                          budget=self._max_corrupt, seen=seen)
+
     def _read_loop(self, tid: int, n_threads: int) -> None:
+        quarantining = self._max_corrupt > 0
         try:
             while not self._stop:
                 read_any = False
                 for i in range(tid, len(self._paths), n_threads):
-                    for rec in read_tfrecords(self._paths[i]):
-                        feats = parse_example(rec)
-                        if self._feature not in feats:
-                            raise ValueError(
-                                f"record missing feature {self._feature!r}")
-                        x = self._decode(feats[self._feature][0])
-                        if self.labeled:
-                            lab = feats.get(self._label_feature)
-                            if not lab:
+                    path = self._paths[i]
+                    on_corrupt = (
+                        (lambda off, why, p=path: self._quarantine(p, off,
+                                                                   why))
+                        if quarantining else None)
+                    for off, rec in read_tfrecords(
+                            path, verify_crc=self._verify_crc,
+                            on_corrupt=on_corrupt, with_offsets=True):
+                        try:
+                            feats = parse_example(rec)
+                            if self._feature not in feats:
                                 raise ValueError(
-                                    "record missing int64 feature "
-                                    f"{self._label_feature!r}")
-                            # same bound as the native loader: reject rather
-                            # than silently wrap/round class ids
-                            if not 0 <= int(lab[0]) <= (1 << 24):
-                                raise ValueError(
-                                    f"label {int(lab[0])} out of range "
-                                    "[0, 2^24]")
-                            x = (x, np.int32(lab[0]))
+                                    "record missing feature "
+                                    f"{self._feature!r}")
+                            x = self._decode(feats[self._feature][0])
+                            if self.labeled:
+                                lab = feats.get(self._label_feature)
+                                if not lab:
+                                    raise ValueError(
+                                        "record missing int64 feature "
+                                        f"{self._label_feature!r}")
+                                # same bound as the native loader: reject
+                                # rather than silently wrap/round class ids
+                                if not 0 <= int(lab[0]) <= (1 << 24):
+                                    raise ValueError(
+                                        f"label {int(lab[0])} out of range "
+                                        "[0, 2^24]")
+                                x = (x, np.int32(lab[0]))
+                        except (ValueError, IndexError, KeyError,
+                                struct.error) as e:
+                            # parse-layer corruption: quarantine the record
+                            # like a CRC failure, or fail-fast when off.
+                            # parse_example surfaces malformed proto bytes
+                            # as struct.error/IndexError, not just
+                            # ValueError — all of them are data faults here
+                            if not quarantining:
+                                raise
+                            self._quarantine(path, off,
+                                             f"{type(e).__name__}: {e}")
+                            continue
                         read_any = True
                         with self._pool_lock:
                             self._pool_lock.wait_for(
@@ -361,7 +421,8 @@ def _make_loader(cfg: DataConfig, paths: Sequence[str], seed: int):
                   prefetch_batches=cfg.prefetch_batches, seed=seed,
                   normalize=cfg.normalize, loop=cfg.loop,
                   feature_name=cfg.feature_name,
-                  label_feature=cfg.label_feature)
+                  label_feature=cfg.label_feature,
+                  max_corrupt_records=cfg.max_corrupt_records)
     if cfg.use_native:
         try:
             from dcgan_tpu.data.native import NativeLoader
@@ -370,7 +431,11 @@ def _make_loader(cfg: DataConfig, paths: Sequence[str], seed: int):
             import warnings
             warnings.warn(f"native loader unavailable ({e}); "
                           "using pure-Python loader")
-    return PythonLoader(paths, **kwargs)
+    # the pure-Python CRC pass is a per-byte Python loop — too slow to run
+    # unconditionally on the fallback path, but quarantine without
+    # verification cannot DETECT a payload flip, so opting in turns it on
+    return PythonLoader(paths, verify_crc=cfg.max_corrupt_records > 0,
+                        **kwargs)
 
 
 def to_global(batch, sharding, label_sharding=None):

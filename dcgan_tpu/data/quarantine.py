@@ -1,0 +1,67 @@
+"""Corrupt-record quarantine: skip-and-count instead of die-on-first.
+
+The seed's policy was fail-fast everywhere: one CRC mismatch raised IOError
+out of data/tfrecord.py, the native loader Fail()ed its whole stream, and a
+multi-day run died over one flipped bit in one shard. This module is the
+shared accounting for the opt-in alternative (`--max_corrupt_records` > 0):
+readers SKIP a bad record, log file+offset so the operator can repair or
+re-prepare the shard, and count it here — bounded, so systemic corruption
+(a truncated dataset, a wrong record_dtype) still hard-fails instead of
+silently quarantining the whole corpus.
+
+The counter is process-global on purpose: corruption totals cross loader
+instances (train + sample pipelines) and both loader implementations (the
+pure-Python readers and the native C++ loader, whose count the ctypes bridge
+mirrors in here), and the trainer surfaces one `data/corrupt_records` scalar
+per process through utils/metrics.py.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_count = 0
+
+
+class CorruptRecordError(IOError):
+    """The corrupt-record budget was exhausted (or quarantine is off and a
+    corrupt record was seen by a quarantine-aware reader)."""
+
+
+def record(path: str, offset: int, reason: str, *,
+           budget: int = 0, seen: int = 1) -> None:
+    """Log + count one quarantined record; raise when `seen` (the calling
+    loader's own running count, budget-scoped) exceeds `budget`."""
+    global _count
+    with _lock:
+        _count += 1
+    print(f"[dcgan_tpu] quarantined corrupt record: {reason} "
+          f"({path} @ byte {offset}; {seen}/{budget} of budget)", flush=True)
+    if seen > budget:
+        raise CorruptRecordError(
+            f"corrupt-record budget exhausted: {seen} corrupt record(s) "
+            f"with --max_corrupt_records={budget}; last was {reason} in "
+            f"{path} @ byte {offset} — repair or re-prepare the shards")
+
+
+def add(n: int) -> None:
+    """Fold externally-counted quarantines (the native loader's) into the
+    process total."""
+    global _count
+    if n > 0:
+        with _lock:
+            _count += n
+
+
+def count() -> int:
+    """Total records quarantined by this process so far."""
+    with _lock:
+        return _count
+
+
+def reset() -> None:
+    """Zero the counter — tests."""
+    global _count
+    with _lock:
+        _count = 0

@@ -65,36 +65,67 @@ def write_tfrecords(path: str, records: Iterable[bytes]) -> int:
     return n
 
 
-def read_tfrecords(path: str, *, verify_crc: bool = False) -> Iterator[bytes]:
+def read_tfrecords(path: str, *, verify_crc: bool = False,
+                   on_corrupt=None,
+                   with_offsets: bool = False) -> Iterator[bytes]:
     """Yield serialized records from a TFRecord file.
 
     CRC verification is off by default in this Python path (the C++ loader
     verifies cheaply with hardware crc32); pass verify_crc=True for tools.
+
+    `on_corrupt(offset, reason)`, when given, switches corruption handling
+    from raise to quarantine: the callback is invoked (it may itself raise —
+    that is how data/quarantine.py enforces its budget) and the reader then
+    SKIPS what it safely can. A data-CRC mismatch skips that one record (the
+    framing is intact — length and both CRC fields read fine — only the
+    payload bytes are bad); a bad length CRC or a truncated tail abandons
+    the rest of the file (the length field itself is untrusted, so there is
+    no safe resync point).
+
+    `with_offsets=True` yields (file_offset, record) pairs instead of bare
+    records, so callers quarantining at the PARSE layer can still log the
+    byte position of the record they skipped.
     """
     if not os.path.exists(path):
         # the reference existence-checks every shard up front
         # (image_input.py:111-113); we fail per-file at open
         raise FileNotFoundError(f"TFRecord shard not found: {path}")
+
+    def _corrupt(offset: int, reason: str) -> bool:
+        """True = quarantined (caller skips); False-path raises."""
+        if on_corrupt is None:
+            raise IOError(f"{reason} in {path}")
+        on_corrupt(offset, reason)
+        return True
+
     with open(path, "rb") as f:
         while True:
+            offset = f.tell()
             header = f.read(12)
             if not header:
                 return
             if len(header) < 12:
-                raise IOError(f"truncated record header in {path}")
+                _corrupt(offset, "truncated record header")
+                return
             (length,) = struct.unpack("<Q", header[:8])
             if verify_crc:
                 (lcrc,) = struct.unpack("<I", header[8:12])
                 if masked_crc32c(header[:8]) != lcrc:
-                    raise IOError(f"length CRC mismatch in {path}")
+                    # the length itself is untrusted — no resync possible
+                    _corrupt(offset, "length CRC mismatch")
+                    return
             data = f.read(length)
             if len(data) < length:
-                raise IOError(f"truncated record body in {path}")
+                _corrupt(offset, "truncated record body")
+                return
             tail = f.read(4)
             if len(tail) < 4:
-                raise IOError(f"truncated record CRC in {path}")
+                _corrupt(offset, "truncated record CRC")
+                return
             if verify_crc:
                 (dcrc,) = struct.unpack("<I", tail)
                 if masked_crc32c(data) != dcrc:
-                    raise IOError(f"data CRC mismatch in {path}")
-            yield data
+                    # framing intact: skip just this record
+                    _corrupt(offset, "data CRC mismatch")
+                    continue
+            yield (offset, data) if with_offsets else data

@@ -1,0 +1,6 @@
+"""Test-support subpackage: deterministic fault injection (chaos.py).
+
+Production code imports from here only through narrow, default-off hooks
+(`chaos.active_plan()` returns None unless a plan was explicitly selected),
+so shipping the injection points costs nothing on the happy path.
+"""

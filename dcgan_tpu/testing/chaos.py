@@ -1,0 +1,166 @@
+"""Deterministic fault injection: the proof harness for the fail-operational
+layer (ISSUE 3 tentpole).
+
+A `FaultPlan` names exactly which fault fires and when — no randomness, so
+every chaos scenario is a reproducible test, not a flake generator. Plans are
+selected explicitly, never ambiently: either programmatically (`set_plan`,
+tests) or through the `DCGAN_CHAOS` environment variable (JSON, read once per
+process — the contract tools/chaos_drill.py uses to arm one fault per
+subprocess). With no plan armed every hook below is a cheap None-check.
+
+Injection points in production code:
+
+- `should_inject_nan(step)`  trainer's numerical-health gate: the gate's view
+  of the step metrics is poisoned ONCE at `nan_at_step` — exercises the
+  `--nan_policy rollback` restore path without needing real divergence.
+- `maybe_io_error(tag)`      inside utils/retry.retry_io attempts: raises one
+  OSError when `io_error_once` equals the site's tag ("ckpt-manifest",
+  "services") — exercises the bounded-retry path.
+- `should_crash_worker(n)`   train/services.py worker: raises before
+  executing the `services_worker_crash`-th task (1-based) — exercises the
+  dispatch-thread error surfacing contract.
+
+Disk faults (`corrupt_record`, `truncate_checkpoint`) are properties of the
+bytes on disk, not of running code, so the plan only CARRIES them for the
+drill's bookkeeping; the drill applies them with the helpers below
+(`corrupt_tfrecord_payload`, `truncate_file`) between process launches.
+
+One-shot semantics: each armed fault fires exactly once per process. That is
+load-bearing for the rollback drill — a step-keyed NaN that re-fired on the
+replayed step would burn the whole `max_rollbacks` budget on one fault.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import struct
+from typing import Optional, Set
+
+ENV_VAR = "DCGAN_CHAOS"
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """One deterministic fault schedule. Zero/empty fields are unarmed."""
+
+    nan_at_step: int = 0           # >0: poison the NaN gate's metrics once
+    corrupt_record: int = 0        # drill bookkeeping: which record index the
+                                   # drill corrupts on disk (helpers below)
+    truncate_checkpoint: int = 0   # drill bookkeeping: which checkpoint step
+                                   # the drill truncates on disk
+    io_error_once: str = ""        # site tag whose next retry_io attempt
+                                   # raises one OSError
+    services_worker_crash: int = 0  # >0: services worker raises before its
+                                    # n-th task (1-based)
+    _fired: Set[str] = dataclasses.field(default_factory=set)
+
+    def fire_once(self, name: str) -> bool:
+        """True exactly once per armed fault name."""
+        if name in self._fired:
+            return False
+        self._fired.add(name)
+        return True
+
+
+_plan: Optional[FaultPlan] = None
+_plan_loaded = False
+
+
+def plan_from_env(env=None) -> Optional[FaultPlan]:
+    """Parse DCGAN_CHAOS (JSON object of FaultPlan fields), or None."""
+    raw = (env if env is not None else os.environ).get(ENV_VAR, "")
+    if not raw:
+        return None
+    fields = {f.name for f in dataclasses.fields(FaultPlan)
+              if not f.name.startswith("_")}
+    d = json.loads(raw)
+    unknown = sorted(set(d) - fields)
+    if unknown:
+        raise ValueError(f"unknown {ENV_VAR} fault(s) {unknown}; "
+                         f"known: {sorted(fields)}")
+    return FaultPlan(**d)
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The process's armed plan: set_plan() wins, else DCGAN_CHAOS (parsed
+    once), else None."""
+    global _plan, _plan_loaded
+    if not _plan_loaded:
+        _plan = plan_from_env()
+        _plan_loaded = True
+    return _plan
+
+
+def set_plan(plan: Optional[FaultPlan]) -> None:
+    """Arm (or with None, disarm) a plan programmatically — tests."""
+    global _plan, _plan_loaded
+    _plan = plan
+    _plan_loaded = True
+
+
+def reset() -> None:
+    """Forget any armed plan AND the env cache (next access re-reads env)."""
+    global _plan, _plan_loaded
+    _plan = None
+    _plan_loaded = False
+
+
+# -- hooks (called from production code; all no-ops without a plan) ----------
+
+def should_inject_nan(step: int) -> bool:
+    plan = active_plan()
+    return bool(plan and plan.nan_at_step
+                and step == plan.nan_at_step
+                and plan.fire_once("nan_at_step"))
+
+
+def maybe_io_error(tag: str) -> None:
+    plan = active_plan()
+    if plan and plan.io_error_once and plan.io_error_once == tag \
+            and plan.fire_once("io_error_once"):
+        raise OSError(f"chaos: injected transient IO error at {tag!r}")
+
+
+def should_crash_worker(task_index: int) -> bool:
+    """`task_index` is 1-based: the n-th task the worker picks up."""
+    plan = active_plan()
+    return bool(plan and plan.services_worker_crash
+                and task_index >= plan.services_worker_crash
+                and plan.fire_once("services_worker_crash"))
+
+
+# -- disk-fault helpers (drill/tests only; never called by production) -------
+
+def corrupt_tfrecord_payload(path: str, record_index: int = 0) -> int:
+    """Flip one byte inside record `record_index`'s payload, leaving its CRC
+    untouched — a CRC-verifying reader sees a data-CRC mismatch at exactly
+    that record. Returns the file offset of the corrupted record."""
+    with open(path, "r+b") as f:
+        idx = 0
+        while True:
+            offset = f.tell()
+            header = f.read(12)
+            if len(header) < 12:
+                raise ValueError(f"{path} has only {idx} record(s); cannot "
+                                 f"corrupt record {record_index}")
+            (length,) = struct.unpack("<Q", header[:8])
+            if idx == record_index:
+                f.seek(offset + 12)   # first payload byte
+                b = f.read(1)
+                f.seek(offset + 12)
+                f.write(bytes([b[0] ^ 0xFF]))
+                return offset
+            f.seek(offset + 12 + length + 4)
+            idx += 1
+
+
+def truncate_file(path: str, drop_bytes: int = 64) -> int:
+    """Chop `drop_bytes` off the end of `path` (at least one byte remains).
+    Returns the new size."""
+    size = os.path.getsize(path)
+    new_size = max(1, size - drop_bytes)
+    with open(path, "r+b") as f:
+        f.truncate(new_size)
+    return new_size

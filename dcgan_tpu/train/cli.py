@@ -167,6 +167,27 @@ def build_parser() -> argparse.ArgumentParser:
                    help="all-process numerical-health gate cadence (0 = "
                         "off); each check reads metric values, which costs "
                         "a device round-trip")
+    p.add_argument("--nan_policy", choices=["abort", "rollback"],
+                   default="abort",
+                   help="tripped NaN gate: abort with step context "
+                        "(reference parity) or restore the last-good host "
+                        "snapshot, skip the offending batch window, and "
+                        "keep training (single-process; bounded by "
+                        "--max_rollbacks)")
+    p.add_argument("--rollback_snapshot_steps", type=int, default=100,
+                   help="with --nan_policy rollback: host-snapshot the "
+                        "gate-verified state every K steps (the restore "
+                        "point)")
+    p.add_argument("--max_rollbacks", type=int, default=3,
+                   help="rollbacks allowed per run before the gate aborts "
+                        "anyway")
+    p.add_argument("--rollback_lr_backoff", type=float, default=1.0,
+                   help="<1.0: multiply both base learning rates by this "
+                        "on every rollback (1.0 = off)")
+    p.add_argument("--max_corrupt_records", type=int, default=0,
+                   help=">0: quarantine (skip + log + count) corrupt "
+                        "TFRecord entries up to this budget before hard-"
+                        "failing; 0 = first corruption is fatal")
     p.add_argument("--log_every_steps", type=int, default=1,
                    help="stdout loss-line cadence (1 = the reference's "
                         "every-step log; 0 = off)")
@@ -251,6 +272,11 @@ _FLAG_FIELDS = {
     "fid_num_samples": ("", "fid_num_samples"),
     "log_every_steps": ("", "log_every_steps"),
     "nan_check_steps": ("", "nan_check_steps"),
+    "nan_policy": ("", "nan_policy"),
+    "rollback_snapshot_steps": ("", "rollback_snapshot_steps"),
+    "max_rollbacks": ("", "max_rollbacks"),
+    "rollback_lr_backoff": ("", "rollback_lr_backoff"),
+    "max_corrupt_records": ("", "max_corrupt_records"),
     "activation_summary_steps": ("", "activation_summary_steps"),
     "profile_dir": ("", "profile_dir"),
     "profile_start_step": ("", "profile_start_step"),

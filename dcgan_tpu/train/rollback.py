@@ -1,0 +1,101 @@
+"""NaN rollback-and-skip: the fail-operational alternative to gate-abort.
+
+The numerical-health gate (trainer `_consume_metrics`, SURVEY.md §5) turns a
+non-finite loss into a `FloatingPointError` with step context. Under the
+default `--nan_policy abort` that kills the job — correct for debugging,
+wasteful for a multi-day run where one pathological batch (or one cosmic-ray
+bit) poisons a step that a different batch window would have sailed through
+(ParaGAN's recovery argument for long GAN runs, PAPERS.md arxiv 2411.03999).
+
+`--nan_policy rollback` keeps a HOST-side copy of the last gate-verified
+state every `rollback_snapshot_steps` steps; when the gate trips, the
+manager puts the snapshot back on device (same shardings), rewinds the
+host's step counter, and training continues — the data iterator is NOT
+rewound, so the batches that fed the poisoned window are naturally skipped,
+and the trainer folds the rollback count into its step-key stream so the
+replayed steps also draw fresh z (a bitwise replay would deterministically
+re-diverge). Optional LR backoff multiplies both nets' base rates per
+rollback. `max_rollbacks` bounds the whole mechanism: persistent divergence
+is a real bug and must still abort.
+
+Host snapshots require fully-addressable arrays, so the policy is
+single-process only (the trainer validates); multi-host keeps abort, whose
+restart-from-checkpoint path is already collective-safe.
+
+Accounting: `rollbacks` is surfaced as the `anomaly/rollbacks` scalar
+through utils/metrics.MetricWriter — one event at each rollback plus the
+running value on every scalars row while nonzero.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+Pytree = Any
+
+
+class RollbackExhausted(FloatingPointError):
+    """The gate tripped more than max_rollbacks times; carries the last
+    gate failure as __cause__."""
+
+
+class RollbackManager:
+    """Last-good snapshot keeper + restore executor for one training run."""
+
+    def __init__(self, *, every: int, max_rollbacks: int,
+                 lr_backoff: float = 1.0, chief: bool = True):
+        if every < 1:
+            raise ValueError(f"snapshot cadence must be >= 1, got {every}")
+        self.every = every
+        self.max_rollbacks = max_rollbacks
+        self.lr_backoff = lr_backoff
+        self.chief = chief
+        self.rollbacks = 0
+        self._snap: Optional[Pytree] = None
+        self._snap_step: Optional[int] = None
+        self._shardings = None
+
+    @property
+    def snapshot_step(self) -> Optional[int]:
+        return self._snap_step
+
+    def due(self, step: int) -> bool:
+        return step % self.every == 0
+
+    def snapshot(self, step: int, state: Pytree) -> None:
+        """Host-copy `state` as the new restore point. The caller passes
+        only gate-verified state (the trainer forces a finiteness check at
+        snapshot boundaries)."""
+        self._shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding if hasattr(x, "sharding") else None, state)
+        self._snap = jax.device_get(state)
+        self._snap_step = int(step)
+
+    def restore(self, exc: FloatingPointError) -> tuple:
+        """Consume one rollback: returns (state, step) rebuilt on device
+        from the snapshot. Raises RollbackExhausted (from `exc`) once the
+        budget is spent."""
+        if self._snap is None:
+            raise exc  # no restore point was ever armed
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            raise RollbackExhausted(
+                f"NaN gate tripped {self.rollbacks} times with "
+                f"max_rollbacks={self.max_rollbacks} — persistent "
+                f"divergence, aborting (last failure: {exc})") from exc
+        if self.chief:
+            print(f"[dcgan_tpu] NaN gate tripped ({exc}); rolling back to "
+                  f"last-good snapshot at step {self._snap_step} "
+                  f"(rollback {self.rollbacks}/{self.max_rollbacks}, "
+                  f"offending batch window will be skipped)", flush=True)
+        state = jax.tree_util.tree_map(
+            lambda host, sh: jax.device_put(host, sh)
+            if sh is not None else host,
+            self._snap, self._shardings)
+        return state, self._snap_step
+
+    def lr_scale(self) -> float:
+        """Cumulative LR multiplier after the rollbacks so far."""
+        return self.lr_backoff ** self.rollbacks

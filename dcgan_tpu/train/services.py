@@ -85,6 +85,10 @@ class HostServices:
     # -- worker side --------------------------------------------------------
 
     def _run(self) -> None:
+        from dcgan_tpu.testing import chaos
+        from dcgan_tpu.utils.retry import retry_io
+
+        n_tasks = 0
         while True:
             with self._lock:
                 while not self._queue and not self._stop:
@@ -94,8 +98,22 @@ class HostServices:
                     return
                 task = self._queue.popleft()
                 self._busy = True
+            n_tasks += 1
             try:
-                task.fn()
+                if chaos.should_crash_worker(n_tasks):
+                    raise RuntimeError(
+                        "chaos: injected services worker crash")
+                # writer tasks are filesystem IO at heart: one transient
+                # OSError (full/fsync-flaky/NFS-hiccup) gets the bounded
+                # jittered-backoff treatment instead of poisoning the
+                # worker; persistent failure still surfaces on the
+                # dispatch thread via raise_if_failed. Trade-off: appends
+                # are not idempotent, so a failure MID-write followed by a
+                # successful retry can leave one torn JSONL line or a
+                # duplicate step row — acceptable for telemetry (readers
+                # should skip unparseable lines), where the alternative
+                # was the whole run dying on the same transient error
+                retry_io(task.fn, tag="services")
                 with self._lock:
                     self.completed += 1
             except BaseException as e:  # noqa: BLE001 — reported to main

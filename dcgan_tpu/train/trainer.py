@@ -40,7 +40,13 @@ import jax
 import numpy as np
 
 from dcgan_tpu.config import TrainConfig, load_config, save_config
-from dcgan_tpu.data import DataConfig, make_dataset, synthetic_batches, to_global
+from dcgan_tpu.data import (
+    DataConfig,
+    make_dataset,
+    quarantine,
+    synthetic_batches,
+    to_global,
+)
 from dcgan_tpu.parallel import (
     batch_sharding,
     initialize_multihost,
@@ -48,6 +54,8 @@ from dcgan_tpu.parallel import (
     make_mesh,
     make_parallel_train,
 )
+from dcgan_tpu.testing import chaos
+from dcgan_tpu.train.rollback import RollbackManager
 from dcgan_tpu.train.services import make_services
 from dcgan_tpu.utils.checkpoint import Checkpointer
 from dcgan_tpu.utils.images import save_sample_grid
@@ -175,7 +183,8 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
         normalize=cfg.normalize_inputs,
         label_feature=cfg.label_feature if conditional else "",
         num_classes=cfg.model.num_classes if conditional else 0,
-        prefetch_device_batches=cfg.prefetch_device_batches)
+        prefetch_device_batches=cfg.prefetch_device_batches,
+        max_corrupt_records=cfg.max_corrupt_records)
     return make_dataset(dcfg, sharding, label_sharding)
 
 
@@ -270,9 +279,21 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
             f"fid_num_samples ({cfg.fid_num_samples}) must divide evenly "
             f"over {jax.process_count()} processes — the in-training probe "
             "splits the sample budget per process (VERDICT r2 #5)")
+    if cfg.nan_policy == "rollback" and jax.process_count() > 1:
+        raise ValueError(
+            "nan_policy='rollback' is single-process only: the last-good "
+            "snapshot is a host copy of the full state, which multi-host "
+            "processes cannot address. Multi-host runs keep nan_policy="
+            "'abort' — the Supervisor-style restart-from-checkpoint path "
+            "is already collective-safe.")
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
     chief = is_chief()
+    # the quarantine tally is process-global (it spans both loader
+    # implementations and the train+sample pipelines); this run reports its
+    # own delta — captured BEFORE any loader thread starts — so counts from
+    # an earlier run in the same process don't bleed into the event stream
+    corrupt_base = quarantine.count()
 
     ckpt = Checkpointer(cfg.checkpoint_dir,
                         save_interval_secs=cfg.save_model_secs,
@@ -312,6 +333,18 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
         if chief:
             print(f"[dcgan_tpu] restored checkpoint at step "
                   f"{int(jax.device_get(state['step']))}")
+
+    # NaN rollback-and-skip (train/rollback.py): under nan_policy="rollback"
+    # a host-side last-good snapshot is refreshed every K steps and a gate
+    # trip restores it instead of aborting; None under the default policy —
+    # the snapshot cost (one full-state device_get per K steps) is strictly
+    # opt-in.
+    rollback = None
+    if cfg.nan_policy == "rollback":
+        rollback = RollbackManager(every=cfg.rollback_snapshot_steps,
+                                   max_rollbacks=cfg.max_rollbacks,
+                                   lr_backoff=cfg.rollback_lr_backoff,
+                                   chief=chief)
 
     # fixed z for comparable sample grids across the run — drawn once, like
     # the reference's graph-build-time sample_z (image_train.py:77)
@@ -485,26 +518,52 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                          jax.device_get(p["metrics"]).items()}
         return p["host"]
 
+    def _health_extras() -> dict:
+        """Recovery counters riding the scalar rows — absent until nonzero,
+        so default-config event streams are byte-identical to pre-recovery
+        builds (the parity contract)."""
+        out = {}
+        if rollback is not None and rollback.rollbacks:
+            out["anomaly/rollbacks"] = rollback.rollbacks
+        n_corrupt = quarantine.count() - corrupt_base
+        if n_corrupt:
+            out["data/corrupt_records"] = n_corrupt
+        return out
+
+    def _nan_gate(p: dict, *, force: bool = False) -> None:
+        """Numerical-health gate (SURVEY.md §5): every process checks the
+        same replicated values, so a NaN/Inf trips the whole job in unison
+        with step context. `force` ignores the cadence — the rollback
+        manager uses it to certify a snapshot candidate even off-cadence.
+        testing/chaos.py can poison THIS view of the metrics (once) to
+        drill the recovery path without real divergence."""
+        s = p["step"]
+        if not force and not (cfg.nan_check_steps
+                              and s % cfg.nan_check_steps == 0):
+            return
+        vals = dict(_host_vals(p))
+        if chaos.should_inject_nan(s):
+            vals["d_loss"] = float("nan")
+        if not all(np.isfinite(v) for v in vals.values()):
+            err = FloatingPointError(
+                f"non-finite training metrics at step {s}: "
+                f"{vals} — inspect the last checkpoint in "
+                f"{cfg.checkpoint_dir}")
+            err.step = s
+            raise err
+
     def _consume_metrics(p: dict) -> None:
         """Host-side consumers of one step's replicated metric scalars:
-        numerical-health gate (SURVEY.md §5 — every process checks the
-        same replicated values, so a NaN/Inf kills the whole job in
-        unison with step context instead of silently training garbage or
-        deadlocking multi-host), stdout step log, and the time-throttled
-        scalar events. With async services this runs lag-by-one: step N's
-        scalars materialize while step N+1 runs on device, so the
-        blocking device_get overlaps compute instead of serializing the
-        pipeline; a NaN still aborts with the right step number, one step
-        later. All cadence math uses the record's own step, so
-        attribution is identical in both modes."""
+        numerical-health gate (abort or hand the trainer's rollback
+        handler a FloatingPointError, per nan_policy), stdout step log,
+        and the time-throttled scalar events. With async services this
+        runs lag-by-one: step N's scalars materialize while step N+1 runs
+        on device, so the blocking device_get overlaps compute instead of
+        serializing the pipeline; a NaN still trips with the right step
+        number, one step later. All cadence math uses the record's own
+        step, so attribution is identical in both modes."""
         s = p["step"]
-        if cfg.nan_check_steps and s % cfg.nan_check_steps == 0:
-            vals = _host_vals(p)
-            if not all(np.isfinite(v) for v in vals.values()):
-                raise FloatingPointError(
-                    f"non-finite training metrics at step {s}: "
-                    f"{vals} — inspect the last checkpoint in "
-                    f"{cfg.checkpoint_dir}")
+        _nan_gate(p)
         if chief and cfg.log_every_steps and s % cfg.log_every_steps == 0:
             m = _host_vals(p)
             epoch = s * cfg.batch_size // epoch_size
@@ -512,17 +571,81 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                   f"time {time.time() - t_start:.1f}s "
                   f"d_loss {m['d_loss']:.4f} g_loss {m['g_loss']:.4f}")
         if p["write_scalars"]:
-            row = {**_host_vals(p), **timer.summary()}
+            row = {**_host_vals(p), **timer.summary(), **_health_extras()}
             svc.submit(lambda: writer.write_scalars(s, row), tag="scalars")
 
     # one step's metrics record awaiting its lag-by-one consumption
     pending: Optional[dict] = None
+
+    def _do_rollback(e: FloatingPointError) -> None:
+        """Recovery executor for a tripped gate under nan_policy="rollback":
+        restore the snapshot (raises RollbackExhausted past the budget),
+        drop checkpoints saved inside the poisoned window (the NaN entered
+        somewhere after the last verified snapshot — a save from that span
+        may embed it), surface anomaly/rollbacks, apply LR backoff (a
+        rebuild of the compiled step — rare-event cost), and re-key the
+        step stream so the replayed window draws fresh z instead of
+        bitwise re-running into the same divergence. The data iterator is
+        NOT rewound: the offending batch window is skipped by construction.
+        """
+        nonlocal state, step_num, pending, pt, base_key
+        fail_step = getattr(e, "step", step_num)
+        state, step_num = rollback.restore(e)
+        pending = None
+        # checkpoint_dir/best is deliberately NOT dropped: its retention is
+        # score-gated (a best-save only happens when the FID probe improved,
+        # and a diverging state scores badly), so a best snapshot from the
+        # poisoned window is both unlikely and self-evidencing — deleting a
+        # possibly-genuinely-best checkpoint would destroy data on a guess
+        dropped = ckpt.delete_steps_after(step_num)
+        if chief:
+            if dropped:
+                print(f"[dcgan_tpu] dropped checkpoint step(s) {dropped} "
+                      f"saved inside the poisoned window", flush=True)
+            svc.submit(lambda s=fail_step, n=rollback.rollbacks:
+                       writer.write_scalars(s, {"anomaly/rollbacks": n}),
+                       tag="anomaly")
+        if rollback.lr_backoff < 1.0:
+            scale = rollback.lr_scale()
+
+            def _bk(lr):
+                return None if lr is None else lr * scale
+
+            pt = make_parallel_train(
+                dataclasses.replace(
+                    cfg, learning_rate=cfg.learning_rate * scale,
+                    d_learning_rate=_bk(cfg.d_learning_rate),
+                    g_learning_rate=_bk(cfg.g_learning_rate)), mesh)
+            if chief:
+                print(f"[dcgan_tpu] rollback LR backoff: base rates "
+                      f"scaled by {scale:.3g}", flush=True)
+        base_key = jax.random.fold_in(jax.random.key(cfg.seed + 2),
+                                      rollback.rollbacks)
+
+    def _consume_or_rollback(p: dict) -> bool:
+        """Consume one metrics record; True = consumed clean, False = the
+        gate tripped and the run was rolled back (the caller restarts its
+        iteration from the restored state). With nan_policy="abort"
+        (default) the FloatingPointError propagates exactly as before."""
+        try:
+            _consume_metrics(p)
+            return True
+        except FloatingPointError as e:
+            if rollback is None:
+                raise
+            _do_rollback(e)
+            return False
 
     # step_num is tracked on the host (it equals state["step"], which the
     # trainer fully determines) — touching the device array every iteration
     # would force a per-step host sync and serialize the pipeline.
     epoch_size = max(1, _epoch_size(cfg))  # hoisted: reads the manifest once
     step_num = start_step
+    if rollback is not None:
+        # arm the initial restore point: a fresh init or a checkpoint
+        # restore — both trusted (the checkpoint passed integrity
+        # verification; a NaN could not have been saved past the gate)
+        rollback.snapshot(step_num, state)
     try:
         while step_num < total_steps:
             svc.raise_if_failed()  # a dead telemetry worker fails loudly
@@ -584,13 +707,15 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                 # instead of blocking dispatch on the device — and start
                 # this step's copies for the next iteration.
                 if pending is not None:
-                    _consume_metrics(pending)
-                    pending = None
+                    prev, pending = pending, None
+                    if not _consume_or_rollback(prev):
+                        continue  # rolled back: restart from restored state
                 _stage(metrics)
             else:
                 # inline escape hatch: NaN gate + step log at the original
                 # call site, synced to THIS step (true step latency)
-                _consume_metrics(cur)
+                if not _consume_or_rollback(cur):
+                    continue
             timer.note_host(time.perf_counter() - host_t0)
             # With per-step logging (the default, matching the reference's
             # every-step stdout log) each tick follows one metric
@@ -604,7 +729,8 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                 if deferred:
                     cur["write_scalars"] = True  # written at the next flush
                 else:
-                    row = {**_host_vals(cur), **timer.summary()}
+                    row = {**_host_vals(cur), **timer.summary(),
+                           **_health_extras()}
                     svc.submit(lambda s=new_step, r=row:
                                writer.write_scalars(s, r), tag="scalars")
                 snap = _snapshot_params(state["params"])
@@ -801,6 +927,21 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                               f"{cfg.checkpoint_dir}/best/{new_step}")
 
             trace.maybe_stop(new_step, sync=metrics)
+            if rollback is not None and rollback.due(new_step):
+                # refresh the restore point — but only with VERIFIED state:
+                # force the gate on this step's metrics (off-cadence too),
+                # and flush the lag-by-one record first so a trip here
+                # attributes to the right step. Forcing materialization
+                # costs one host sync per K steps — the snapshot's price.
+                try:
+                    _nan_gate(cur, force=True)
+                    if pending is not None:
+                        _consume_metrics(pending)
+                        pending = None
+                    rollback.snapshot(new_step, state)
+                except FloatingPointError as e:
+                    _do_rollback(e)
+                    continue
             if ckpt.maybe_save(new_step, state):
                 # drain-on-checkpoint barrier: every telemetry event
                 # submitted before this checkpoint is durable before

@@ -1,4 +1,4 @@
-"""Checkpoint / resume (Orbax-backed).
+"""Checkpoint / resume (Orbax-backed) with integrity verification.
 
 The reference's story (SURVEY.md §3.3, §5): a tf.train.Saver over all
 variables (image_train.py:103), Supervisor-driven periodic save every 600 s on
@@ -7,16 +7,62 @@ the chief only (image_train.py:123-129), and restore-latest on startup
 pytree — params, BN running stats, both Adam states, step — with Orbax doing
 sharded, async-capable array IO (each host writes its shards; no PS process
 holds "the" copy).
+
+Integrity layer (ISSUE 3): Orbax's tmp+rename protocol guarantees a step
+directory is COMPLETE, not that its bytes stay GOOD — a post-rename partial
+flush on power loss, a filesystem that silently truncates, or plain bit rot
+all leave an integer-named dir whose restore dies mid-run with an opaque
+array error, and the seed had no fallback. Here every finalized step gets a
+checksum manifest (`<dir>/integrity/<step>.json`, size + crc32 per file,
+written atomically via tmp+rename, chief-only); `restore_latest` verifies
+the newest step against its manifest first, renames a failing step to
+`<step>.corrupt` (kept for forensics, invisible to the step scanner), and
+falls back to the next-newest intact checkpoint. Steps without a manifest
+(legacy dirs, or a crash before the manifest landed) are trusted as before —
+verification only ever ADDS protection. Manifest IO runs under
+utils/retry.retry_io, so one transient host-IO error does not fail a save.
 """
 
 from __future__ import annotations
 
+import json
+import os
 import time
-from typing import Any, Optional
+import zlib
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 
 Pytree = Any
+
+INTEGRITY_DIRNAME = "integrity"
+
+
+def _file_checksum(path: str, chunk: int = 1 << 20) -> Tuple[int, int]:
+    """(size, crc32) of one file, streamed."""
+    size = 0
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            size += len(block)
+            crc = zlib.crc32(block, crc)
+    return size, crc & 0xFFFFFFFF
+
+
+def _dir_checksums(step_dir: str) -> Dict[str, Dict[str, int]]:
+    """{relative path: {size, crc32}} over every regular file under
+    `step_dir`."""
+    out: Dict[str, Dict[str, int]] = {}
+    for root, _, files in os.walk(step_dir):
+        for name in sorted(files):
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, step_dir)
+            size, crc = _file_checksum(path)
+            out[rel] = {"size": size, "crc32": crc}
+    return out
 
 
 def has_restorable_checkpoint(directory: str) -> bool:
@@ -58,11 +104,11 @@ class Checkpointer:
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
+        self._mgr_options = dict(max_to_keep=max_to_keep,
+                                 enable_async_checkpointing=async_save)
         self._mgr = ocp.CheckpointManager(
             self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                enable_async_checkpointing=async_save))
+            options=ocp.CheckpointManagerOptions(**self._mgr_options))
         self.save_interval_secs = save_interval_secs
         self.save_interval_steps = save_interval_steps
         self._next_save = time.time() + save_interval_secs
@@ -71,6 +117,120 @@ class Checkpointer:
         self._mgr.save(int(step),
                        args=self._ocp.args.StandardSave(state),
                        force=force)
+        # manifest any step finalized by now (with async saves that is the
+        # PREVIOUS save — this step's manifest lands on the next call/wait)
+        self._write_pending_manifests()
+
+    # -- integrity manifests -------------------------------------------------
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, INTEGRITY_DIRNAME,
+                            f"{int(step)}.json")
+
+    def _finalized_steps(self) -> list:
+        """Integer-named step dirs on disk, newest first. Orbax's tmp+rename
+        finalize means an integer-named dir is complete; in-flight temp dirs
+        carry a suffix and fail the digit test (same contract as
+        has_restorable_checkpoint)."""
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return []
+        return sorted(
+            (int(n) for n in entries if n.isdigit()
+             and os.path.isdir(os.path.join(self.directory, n))),
+            reverse=True)
+
+    def _write_pending_manifests(self) -> None:
+        """Write the checksum manifest for every finalized step that lacks
+        one. Chief-only (one writer per shared filesystem); manifest IO —
+        not the Orbax array writes — retries transient OSErrors with
+        jittered backoff (utils/retry)."""
+        if jax.process_index() != 0:
+            return
+        from dcgan_tpu.utils.retry import retry_io
+
+        # prune manifests whose step Orbax retention already deleted (keep
+        # the manifest beside a .corrupt dir — forensics)
+        int_dir = os.path.join(self.directory, INTEGRITY_DIRNAME)
+        try:
+            stale = [n for n in os.listdir(int_dir)
+                     if n.endswith(".json") and n[:-5].isdigit()
+                     and not os.path.exists(
+                         os.path.join(self.directory, n[:-5]))
+                     and not os.path.exists(
+                         os.path.join(self.directory, n[:-5] + ".corrupt"))]
+        except OSError:
+            stale = []
+        for name in stale:
+            try:
+                os.remove(os.path.join(int_dir, name))
+            except OSError:
+                pass
+
+        for step in self._finalized_steps():
+            path = self._manifest_path(step)
+            if os.path.exists(path):
+                continue
+            step_dir = os.path.join(self.directory, str(step))
+
+            def _write(step=step, path=path, step_dir=step_dir):
+                manifest = {"step": step, "files": _dir_checksums(step_dir)}
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                tmp = f"{path}.{os.getpid()}.tmp"
+                with open(tmp, "w") as f:
+                    json.dump(manifest, f, indent=1, sort_keys=True)
+                os.replace(tmp, path)
+
+            retry_io(_write, tag="ckpt-manifest")
+
+    def _verify_step(self, step: int) -> Tuple[bool, str]:
+        """Check a finalized step dir against its manifest. No manifest =
+        trusted (legacy dirs and crash-before-manifest saves keep the seed's
+        restore semantics — verification only ever adds protection)."""
+        path = self._manifest_path(step)
+        if not os.path.exists(path):
+            return True, "no integrity manifest (unverified)"
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+            files = manifest["files"]
+        except (OSError, ValueError, KeyError) as e:
+            # an unreadable manifest is a manifest-side problem, not
+            # evidence against the arrays — trust the step, say so
+            return True, f"unreadable integrity manifest ({e})"
+        step_dir = os.path.join(self.directory, str(step))
+        for rel, rec in files.items():
+            fpath = os.path.join(step_dir, rel)
+            if not os.path.exists(fpath):
+                return False, f"missing file {rel!r}"
+            size, crc = _file_checksum(fpath)
+            if size != rec["size"]:
+                return False, (f"size mismatch on {rel!r} "
+                               f"({size} != {rec['size']})")
+            if crc != rec["crc32"]:
+                return False, f"crc32 mismatch on {rel!r}"
+        return True, "verified"
+
+    def _mark_corrupt(self, step: int, why: str) -> None:
+        """Rename a failing step dir to `<step>.corrupt` (chief-only): the
+        step scanner and Orbax both ignore non-integer names, the bytes stay
+        on disk for forensics, and the manifest stays beside it."""
+        src = os.path.join(self.directory, str(step))
+        dst = f"{src}.corrupt"
+        print(f"[dcgan_tpu] checkpoint step {step} failed integrity check "
+              f"({why}) — marking {dst} and falling back to the newest "
+              f"intact checkpoint", flush=True)
+        if jax.process_index() == 0 and os.path.isdir(src):
+            os.replace(src, dst)
+        try:
+            self._mgr.reload()  # drop the manager's cached step metadata
+        except Exception:  # older orbax without reload(): rebuild instead
+            self._mgr.close()
+            self._mgr = self._ocp.CheckpointManager(
+                self.directory,
+                options=self._ocp.CheckpointManagerOptions(
+                    **self._mgr_options))
 
     def maybe_save(self, step: int, state: Pytree) -> bool:
         """Throttled save — the Supervisor's save_model_secs=600 cadence
@@ -95,27 +255,65 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def delete_steps_after(self, step: int) -> list:
+        """Remove checkpoints NEWER than `step`; returns the steps dropped.
+
+        Rollback support (train/rollback.py): a save taken between the
+        last-good snapshot and the gate trip may embed the divergence the
+        gate only caught later (the gate runs every nan_check_steps, not
+        every step), and a replayed save at the same step number would
+        collide with the stale dir. Single-process callers only — Orbax
+        deletion is not a collective here."""
+        dropped = [s for s in self._finalized_steps() if s > step]
+        if dropped:
+            self._mgr.wait_until_finished()  # never race an in-flight save
+            for s in dropped:
+                self._mgr.delete(s)
+                # the manifest must die with the step: a REPLAYED save at
+                # this step number writes different bytes, and verifying
+                # them against the stale manifest would falsely mark the
+                # good checkpoint .corrupt at the next restore
+                try:
+                    os.remove(self._manifest_path(s))
+                except OSError:
+                    pass
+        return dropped
+
     def restore_latest(self, target_state: Pytree) -> Optional[Pytree]:
-        """Restore the newest checkpoint into the shape/sharding of
+        """Restore the newest INTACT checkpoint into the shape/sharding of
         `target_state` (pass the freshly-initialized state); None if no
         checkpoint exists — the reference's load() boolean contract
-        (image_train.py:233-245)."""
-        step = self._mgr.latest_step()
-        if step is None:
-            return None
+        (image_train.py:233-245).
+
+        Candidates are tried newest-first: a step whose integrity manifest
+        disagrees with the bytes on disk is renamed `<step>.corrupt` and the
+        next-newest step is tried — a truncated latest checkpoint costs the
+        run its most recent save interval, not the whole run. Steps without
+        a manifest restore exactly as before (unverified), and restore-time
+        exceptions still propagate — only MANIFEST-proven corruption
+        quarantines a step, so a tree/shape mismatch can never silently
+        retire good checkpoints."""
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=getattr(x, "sharding",
                                                             None))
             if hasattr(x, "shape") else x,
             target_state)
-        return self._mgr.restore(
-            step, args=self._ocp.args.StandardRestore(abstract))
+        for step in self._finalized_steps():
+            ok, why = self._verify_step(step)
+            if not ok:
+                self._mark_corrupt(step, why)
+                continue
+            return self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore(abstract))
+        return None
 
     def wait(self) -> None:
-        """Block until async saves are durable."""
+        """Block until async saves are durable (and manifest them)."""
         self._mgr.wait_until_finished()
+        self._write_pending_manifests()
 
     def close(self) -> None:
         self._mgr.wait_until_finished()
+        self._write_pending_manifests()
         self._mgr.close()

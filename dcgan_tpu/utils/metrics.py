@@ -21,21 +21,35 @@ import numpy as np
 
 def histogram_summary(values, bins: int = 30) -> Dict[str, Any]:
     """Compact histogram record (the replacement for tf.histogram_summary,
-    distriubted_model.py:79): moments + sparsity + binned counts."""
+    distriubted_model.py:79): moments + sparsity + binned counts.
+
+    Non-finite-safe: a tensor carrying NaN/Inf (a diverging run mid-flight)
+    bins its FINITE values and reports a `nonfinite_count` key instead of
+    crashing the writer — telemetry degrades, the numerical-health gate
+    (not the histogram channel) owns killing the run. The extra key appears
+    ONLY when non-finite values exist, so healthy runs' event records are
+    byte-identical to before."""
     arr = np.asarray(values, dtype=np.float32).ravel()
-    counts, edges = np.histogram(arr, bins=bins)
-    return {
+    finite = arr[np.isfinite(arr)] if arr.size else arr
+    if finite.size:
+        counts, edges = np.histogram(finite, bins=bins)
+    else:
+        counts, edges = np.histogram([], bins=bins, range=(0.0, 1.0))
+    out = {
         "count": int(arr.size),
-        "min": float(arr.min()) if arr.size else 0.0,
-        "max": float(arr.max()) if arr.size else 0.0,
-        "mean": float(arr.mean()) if arr.size else 0.0,
-        "std": float(arr.std()) if arr.size else 0.0,
+        "min": float(finite.min()) if finite.size else 0.0,
+        "max": float(finite.max()) if finite.size else 0.0,
+        "mean": float(finite.mean()) if finite.size else 0.0,
+        "std": float(finite.std()) if finite.size else 0.0,
         # zero_fraction: the reference's per-layer sparsity scalar
         # (distriubted_model.py:80)
         "zero_fraction": float(np.mean(arr == 0.0)) if arr.size else 0.0,
         "bin_edges": [float(e) for e in edges],
         "bin_counts": [int(c) for c in counts],
     }
+    if finite.size != arr.size:
+        out["nonfinite_count"] = int(arr.size - finite.size)
+    return out
 
 
 def activation_stats(acts: Mapping[str, Any], bins: int = 30,

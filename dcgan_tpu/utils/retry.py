@@ -1,0 +1,57 @@
+"""Bounded retry with jittered exponential backoff for transient host IO.
+
+The fail-operational layer's policy for IO that is *retryable by nature*
+(filesystem writes of derived artifacts: checkpoint integrity manifests,
+metric/event files): a transient `OSError` gets a few spaced attempts before
+it becomes a real failure, instead of killing a multi-hour run over one NFS
+hiccup. Deliberately NOT used around Orbax array writes themselves — a
+half-finished collective save is not safely re-enterable from this layer;
+Orbax's tmp+rename protocol plus the integrity manifest fallback in
+utils/checkpoint.py own that failure mode.
+
+Jitter is deterministic (seeded from the site tag + attempt number): two
+processes retrying the same site still decorrelate, and a chaos drill run
+is exactly reproducible.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+from dcgan_tpu.testing import chaos
+
+T = TypeVar("T")
+
+DEFAULT_ATTEMPTS = 3
+DEFAULT_BASE_DELAY_S = 0.05
+DEFAULT_MAX_DELAY_S = 2.0
+
+
+def retry_io(fn: Callable[[], T], *, tag: str,
+             attempts: int = DEFAULT_ATTEMPTS,
+             base_delay_s: float = DEFAULT_BASE_DELAY_S,
+             max_delay_s: float = DEFAULT_MAX_DELAY_S,
+             retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+             sleep: Callable[[float], None] = time.sleep) -> T:
+    """Run `fn` with up to `attempts` tries; `retry_on` failures back off
+    (base * 2^i plus deterministic jitter, capped) between tries, and the
+    last failure propagates unchanged. `tag` names the site in logs and is
+    the chaos hook's selector (testing/chaos.py io_error_once)."""
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    for attempt in range(attempts):
+        try:
+            chaos.maybe_io_error(tag)
+            return fn()
+        except retry_on as e:
+            if attempt == attempts - 1:
+                raise
+            delay = min(max_delay_s, base_delay_s * (2 ** attempt))
+            delay *= 0.5 + random.Random(f"{tag}:{attempt}").random()
+            print(f"[dcgan_tpu] transient IO error at {tag!r} "
+                  f"(attempt {attempt + 1}/{attempts}): {e} — "
+                  f"retrying in {delay * 1e3:.0f} ms", flush=True)
+            sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

@@ -151,6 +151,89 @@ class TestExportSampler:
             pt.sample(state, jax.numpy.asarray(z))))
         np.testing.assert_allclose(imgs, ref, atol=1e-5)
 
+    def test_resnet_checkpoint_exports_and_matches(self, tmp_path_factory,
+                                                   tmp_path):
+        """Round-trip for the second model family (VERDICT next-round #6):
+        a spectral-norm resnet checkpoint — whose generator restore rides
+        the same tree as the SN power-iteration state — must export to
+        StableHLO and reproduce the framework sampler exactly."""
+        import jax
+
+        from dcgan_tpu.parallel import make_mesh, make_parallel_train
+        from dcgan_tpu.utils.checkpoint import Checkpointer
+
+        ckpt = _train_ckpt(tmp_path_factory.mktemp("export_resnet"),
+                           arch="resnet", spectral_norm="d")
+        ov = {"arch": "resnet", "output_size": 16, "gf_dim": 8, "df_dim": 8,
+              "spectral_norm": "d"}
+        out = str(tmp_path / "resnet.jaxexport")
+        meta = export_sampler(ckpt, out, overrides=ov, platforms=("cpu",))
+        assert meta["arch"] == "resnet"
+        z = np.random.default_rng(2).uniform(
+            -1, 1, size=(8, 100)).astype(np.float32)
+        imgs = np.asarray(load_sampler(out).call(z))
+        assert imgs.shape == (8, 16, 16, 3)
+        assert np.isfinite(imgs).all()
+
+        cfg = TrainConfig(model=ModelConfig(arch="resnet", output_size=16,
+                                            gf_dim=8, df_dim=8,
+                                            spectral_norm="d",
+                                            compute_dtype="float32"),
+                          batch_size=8, checkpoint_dir=ckpt)
+        pt = make_parallel_train(cfg, make_mesh(cfg.mesh))
+        state = Checkpointer(ckpt).restore_latest(pt.init(jax.random.key(0)))
+        assert any(k.startswith("sn_") for k in state["bn"]["disc"])
+        ref = np.asarray(jax.device_get(
+            pt.sample(state, jax.numpy.asarray(z))))
+        np.testing.assert_allclose(imgs, ref, atol=1e-5)
+
+    def test_stylegan_ema_checkpoint_exports_and_matches(
+            self, tmp_path_factory, tmp_path):
+        """Third family: StyleGAN2-lite's per-sample weight modulation must
+        survive both the symbolic-batch export (modulated convs reshape by
+        the batch dim) and the EMA weight source."""
+        import jax
+
+        from dcgan_tpu.parallel import make_mesh, make_parallel_train
+        from dcgan_tpu.utils.checkpoint import Checkpointer
+
+        root = tmp_path_factory.mktemp("export_stylegan")
+        cfg = TrainConfig(
+            model=ModelConfig(arch="stylegan", output_size=16, gf_dim=8,
+                              df_dim=8, compute_dtype="float32"),
+            batch_size=8, g_ema_decay=0.5,
+            checkpoint_dir=str(root / "ckpt"),
+            sample_dir=str(root / "samples"),
+            sample_every_steps=0, save_summaries_secs=1e9,
+            save_model_secs=1e9, log_every_steps=0)
+        train(cfg, synthetic_data=True, max_steps=2)
+        ckpt = str(root / "ckpt")
+        ov = {"arch": "stylegan", "output_size": 16, "gf_dim": 8,
+              "df_dim": 8}
+        out = str(tmp_path / "sg.jaxexport")
+        meta = export_sampler(ckpt, out, overrides=ov, platforms=("cpu",),
+                              use_ema=True)
+        assert meta["arch"] == "stylegan" and meta["weights"] == "ema"
+        exported = load_sampler(out)
+        z = np.random.default_rng(3).uniform(
+            -1, 1, size=(8, 100)).astype(np.float32)
+        imgs = np.asarray(exported.call(z))
+        assert imgs.shape == (8, 16, 16, 3)
+        assert np.isfinite(imgs).all()
+        # symbolic batch must serve odd sizes too — per-sample modulation
+        # is the path most likely to have baked the trace batch
+        assert np.asarray(exported.call(z[:3])).shape == (3, 16, 16, 3)
+        np.testing.assert_allclose(np.asarray(exported.call(z[:3])),
+                                   imgs[:3], atol=1e-5)
+
+        # exact match against the framework's EMA sampler (pt.sample reads
+        # ema_gen when g_ema_decay > 0)
+        pt = make_parallel_train(cfg, make_mesh(cfg.mesh))
+        state = Checkpointer(ckpt).restore_latest(pt.init(jax.random.key(0)))
+        ref = np.asarray(jax.device_get(
+            pt.sample(state, jax.numpy.asarray(z))))
+        np.testing.assert_allclose(imgs, ref, atol=1e-5)
+
     def test_cli_and_flag_coverage(self, ckpt, tmp_path):
         parser = build_parser()
         args = parser.parse_args(["--checkpoint_dir", ckpt])

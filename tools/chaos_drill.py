@@ -1,0 +1,284 @@
+"""Chaos drill: the fail-operational layer's scenario matrix, end to end.
+
+Each scenario arms ONE deterministic fault (dcgan_tpu/testing/chaos.py,
+selected per subprocess through the DCGAN_CHAOS env var, or applied to the
+bytes on disk between launches) and runs the REAL trainer on CPU, then
+asserts the recovery contract: the run either completes with the right
+final step and recovery counters, or fails loudly with the right error —
+never silently trains garbage, never hangs.
+
+    scenario              fault                          asserted recovery
+    --------------------  -----------------------------  --------------------
+    nan-rollback          NaN into the health gate       rollback to last-good
+                          mid-run                        snapshot, run
+                                                         completes, anomaly/
+                                                         rollbacks surfaced
+    corrupt-record        payload bit-flip in a shard    record skipped +
+                          (within budget)                data/corrupt_records
+                                                         counted, run completes
+    corrupt-budget        same flip, budget exhausted    hard failure naming
+                                                         the budget
+    truncate-checkpoint   newest checkpoint truncated    integrity fallback to
+                          between runs                   the previous step,
+                                                         step marked .corrupt,
+                                                         resume completes
+    io-error-once         one transient OSError in the   retried with backoff,
+                          manifest write path            run completes
+    services-crash        background services worker     ServiceError surfaces
+                          dies                           on the dispatch
+                                                         thread, run aborts
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py            # full matrix
+    JAX_PLATFORMS=cpu python tools/chaos_drill.py --smoke    # CI subset
+    ... --only nan-rollback truncate-checkpoint              # cherry-pick
+
+Prints one JSON row per scenario and exits nonzero if any scenario's
+contract does not hold. Tiny model (16px, gf/df 8, batch 8): the matrix is a
+protocol check, ~10 s/launch on CPU — the numbers mean nothing, the
+recovery paths everything.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# CI subset (tests/test_tools.py pins --smoke into tier-1): the cheapest
+# scenarios that still cross every new layer — quarantine (data), retry
+# (checkpoint IO), worker-crash surfacing (services). The two-phase
+# checkpoint-fallback and rollback scenarios run in the full matrix (and
+# in-process in tests/test_chaos.py).
+SMOKE_SCENARIOS = ("corrupt-record", "io-error-once", "services-crash")
+
+_DRIVER = """
+import jax; jax.config.update("jax_platforms", "cpu")
+from dcgan_tpu.config import ModelConfig, TrainConfig
+from dcgan_tpu.train.trainer import train
+cfg = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                                    compute_dtype="float32"),
+                  batch_size=8, tensorboard=False, sample_every_steps=0,
+                  save_summaries_secs=0.0, log_every_steps=1,
+                  **{extra!r})
+state = train(cfg, synthetic_data={synthetic!r}, max_steps={max_steps!r})
+print("TRAIN_DONE step=%d" % int(jax.device_get(state["step"])), flush=True)
+"""
+
+
+def _run_train(extra: dict, *, max_steps: int, synthetic: bool = True,
+               chaos: dict = None, timeout: int = 600):
+    """One trainer subprocess; returns (rc, combined output)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DCGAN_CHAOS", None)
+    if chaos:
+        env["DCGAN_CHAOS"] = json.dumps(chaos)
+    code = _DRIVER.format(extra=extra, synthetic=synthetic,
+                          max_steps=max_steps)
+    res = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=timeout)
+    return res.returncode, res.stdout + res.stderr
+
+
+def _events(ckpt_dir: str):
+    path = os.path.join(ckpt_dir, "events.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def _scalar_values(events, key):
+    return [e["values"][key] for e in events
+            if e["kind"] == "scalars" and key in e["values"]]
+
+
+class Failure(AssertionError):
+    pass
+
+
+def _check(cond, why):
+    if not cond:
+        raise Failure(why)
+
+
+# -- scenarios ---------------------------------------------------------------
+
+def scenario_nan_rollback(root: str) -> dict:
+    """NaN mid-run -> rollback to last-good snapshot, training resumes and
+    completes; anomaly/rollbacks lands in the event stream."""
+    ck = os.path.join(root, "ck")
+    rc, out = _run_train(
+        dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+             nan_policy="rollback", nan_check_steps=1,
+             rollback_snapshot_steps=2, max_rollbacks=2,
+             rollback_lr_backoff=0.5, save_model_secs=1e9),
+        max_steps=6, chaos={"nan_at_step": 3})
+    _check(rc == 0, f"trainer failed (rc={rc}): {out[-800:]}")
+    _check("rolling back to last-good snapshot at step 2" in out,
+           f"no rollback message in output: {out[-800:]}")
+    _check("TRAIN_DONE step=6" in out, f"run did not complete: {out[-400:]}")
+    rollbacks = _scalar_values(_events(ck), "anomaly/rollbacks")
+    _check(rollbacks and max(rollbacks) >= 1,
+           f"anomaly/rollbacks missing from events (got {rollbacks})")
+    return {"rollbacks": max(rollbacks), "final_step": 6}
+
+
+def _make_corrupt_shards(root: str) -> str:
+    from dcgan_tpu.data.synthetic import write_image_tfrecords
+    from dcgan_tpu.testing.chaos import corrupt_tfrecord_payload
+
+    data_dir = os.path.join(root, "data")
+    paths = write_image_tfrecords(data_dir, num_examples=64, image_size=16,
+                                  num_shards=2)
+    for p in paths:   # one bad record per shard
+        corrupt_tfrecord_payload(p, record_index=2)
+    return data_dir
+
+
+def scenario_corrupt_record(root: str) -> dict:
+    """Flipped payload bytes within budget -> records skipped, counter
+    surfaced, run completes."""
+    data_dir = _make_corrupt_shards(root)
+    ck = os.path.join(root, "ck")
+    rc, out = _run_train(
+        dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+             data_dir=data_dir, max_corrupt_records=1000,
+             shuffle_buffer=16, num_loader_threads=2, save_model_secs=1e9),
+        max_steps=6, synthetic=False)
+    _check(rc == 0, f"trainer failed (rc={rc}): {out[-800:]}")
+    _check("quarantined corrupt record" in out,
+           f"no quarantine log line: {out[-800:]}")
+    _check("TRAIN_DONE step=6" in out, f"run did not complete: {out[-400:]}")
+    counts = _scalar_values(_events(ck), "data/corrupt_records")
+    _check(counts and max(counts) >= 1,
+           f"data/corrupt_records missing from events (got {counts})")
+    return {"corrupt_records": int(max(counts)), "final_step": 6}
+
+
+def scenario_corrupt_budget(root: str) -> dict:
+    """Same corruption with budget 1 and >1 bad records on disk -> the run
+    must HARD-FAIL naming the budget (bounded quarantine, not unbounded
+    tolerance)."""
+    data_dir = _make_corrupt_shards(root)
+    rc, out = _run_train(
+        dict(checkpoint_dir=os.path.join(root, "ck"),
+             sample_dir=os.path.join(root, "sm"),
+             data_dir=data_dir, max_corrupt_records=1,
+             shuffle_buffer=16, num_loader_threads=2, save_model_secs=1e9),
+        max_steps=200, synthetic=False)
+    _check(rc != 0, "budget-exhausted run unexpectedly succeeded")
+    _check("budget" in out, f"failure does not name the budget: {out[-800:]}")
+    return {"failed_as_required": True}
+
+
+def scenario_truncate_checkpoint(root: str) -> dict:
+    """Truncate the newest checkpoint between runs -> integrity fallback
+    restores the previous step, marks .corrupt, resume completes."""
+    from dcgan_tpu.testing.chaos import truncate_file
+
+    ck = os.path.join(root, "ck")
+    common = dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+                  save_model_secs=0.0)  # save (and manifest) every step
+    rc, out = _run_train(common, max_steps=4)
+    _check(rc == 0, f"phase-A trainer failed (rc={rc}): {out[-800:]}")
+    _check(os.path.isdir(os.path.join(ck, "4")), "no step-4 checkpoint")
+    _check(os.path.exists(os.path.join(ck, "integrity", "4.json")),
+           "no integrity manifest for step 4")
+    # truncate the biggest array file in the newest step
+    files = [p for p in glob.glob(os.path.join(ck, "4", "**"),
+                                  recursive=True) if os.path.isfile(p)]
+    victim = max(files, key=os.path.getsize)
+    truncate_file(victim, drop_bytes=max(64, os.path.getsize(victim) // 2))
+
+    rc, out = _run_train(common, max_steps=6)
+    _check(rc == 0, f"phase-B trainer failed (rc={rc}): {out[-800:]}")
+    _check("failed integrity check" in out,
+           f"no integrity-failure message: {out[-800:]}")
+    _check(os.path.isdir(os.path.join(ck, "4.corrupt")),
+           "truncated step was not marked .corrupt")
+    _check("restored checkpoint at step 3" in out,
+           f"did not fall back to step 3: {out[-800:]}")
+    _check("TRAIN_DONE step=6" in out, f"resume did not complete: "
+           f"{out[-400:]}")
+    return {"fell_back_to": 3, "final_step": 6}
+
+
+def scenario_io_error_once(root: str) -> dict:
+    """One transient OSError in the checkpoint-manifest write -> retried
+    with backoff, run completes, manifests intact."""
+    ck = os.path.join(root, "ck")
+    rc, out = _run_train(
+        dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+             save_model_secs=0.0),
+        max_steps=3, chaos={"io_error_once": "ckpt-manifest"})
+    _check(rc == 0, f"trainer failed (rc={rc}): {out[-800:]}")
+    _check("transient IO error at 'ckpt-manifest'" in out
+           and "retrying" in out, f"no retry log line: {out[-800:]}")
+    _check("TRAIN_DONE step=3" in out, f"run did not complete: {out[-400:]}")
+    _check(glob.glob(os.path.join(ck, "integrity", "*.json")),
+           "no integrity manifests written")
+    return {"retried": True, "final_step": 3}
+
+
+def scenario_services_crash(root: str) -> dict:
+    """Background services worker dies -> the error surfaces on the
+    DISPATCH thread (ServiceError) and the run aborts loudly."""
+    rc, out = _run_train(
+        dict(checkpoint_dir=os.path.join(root, "ck"),
+             sample_dir=os.path.join(root, "sm"), save_model_secs=1e9),
+        max_steps=50, chaos={"services_worker_crash": 1})
+    _check(rc != 0, "run with a dead services worker unexpectedly succeeded")
+    _check("ServiceError" in out and "background host service" in out,
+           f"worker crash did not surface as ServiceError: {out[-800:]}")
+    _check("TRAIN_DONE" not in out, "run claimed completion after crash")
+    return {"failed_as_required": True}
+
+
+SCENARIOS = {
+    "nan-rollback": scenario_nan_rollback,
+    "corrupt-record": scenario_corrupt_record,
+    "corrupt-budget": scenario_corrupt_budget,
+    "truncate-checkpoint": scenario_truncate_checkpoint,
+    "io-error-once": scenario_io_error_once,
+    "services-crash": scenario_services_crash,
+}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="chaos_drill",
+        description="fault-injection scenario matrix for the trainer's "
+                    "fail-operational layer (CPU)")
+    p.add_argument("--smoke", action="store_true",
+                   help=f"CI subset: {', '.join(SMOKE_SCENARIOS)}")
+    p.add_argument("--only", nargs="+", choices=sorted(SCENARIOS),
+                   default=None, help="run just these scenarios")
+    args = p.parse_args(argv)
+    names = (args.only if args.only
+             else SMOKE_SCENARIOS if args.smoke else sorted(SCENARIOS))
+    failures = 0
+    for name in names:
+        with tempfile.TemporaryDirectory(prefix=f"chaos_{name}_") as root:
+            row = {"scenario": name}
+            try:
+                row.update(SCENARIOS[name](root))
+                row["ok"] = True
+            except Failure as e:
+                row.update(ok=False, error=str(e))
+                failures += 1
+            print(json.dumps(row), flush=True)
+    print(json.dumps({"label": "chaos-drill", "scenarios": len(names),
+                      "failed": failures}), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

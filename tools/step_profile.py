@@ -137,6 +137,40 @@ def main() -> None:
     compiled = jax.jit(fns.train_step, donate_argnums=(0,)).lower(
         state, images, base).compile()
 
+    # VERDICT Weak #6: XLA's cost model counts a lax.scan (while-loop) body
+    # ONCE regardless of trip count, so any in-step scan — the n_critic
+    # critic loop (wgan-gp: 5), the grad_accum microbatch loops — under-
+    # counts the step's true FLOP/bytes by ~(trips-1) bodies. When the
+    # config scans, lower a SECOND, fully-unrolled variant purely for cost
+    # analysis (scan with unroll=length emits the body `length` times, so
+    # the per-op accounting is exact; verified flops(unroll=k) == k*body on
+    # this backend). Timing always uses the real rolled program.
+    scan_trips = {}
+    if cfg.n_critic > 1:
+        scan_trips["n_critic"] = cfg.n_critic
+    if cfg.grad_accum > 1:
+        scan_trips["grad_accum"] = cfg.grad_accum
+    compiled_for_cost = compiled
+    if scan_trips:
+        orig_scan = lax.scan
+
+        def _unrolled_scan(f, init, xs=None, length=None, **kw):
+            n = length if length is not None else \
+                jax.tree_util.tree_leaves(xs)[0].shape[0]
+            kw["unroll"] = max(1, int(n))
+            return orig_scan(f, init, xs, length=length, **kw)
+
+        # contained monkeypatch: steps.py references the same jax.lax
+        # module object, so every in-step scan unrolls for this one lowering
+        lax.scan = _unrolled_scan
+        try:
+            cost_fns = make_train_step(cfg)
+            compiled_for_cost = jax.jit(
+                cost_fns.train_step, donate_argnums=(0,)).lower(
+                    state, images, base).compile()
+        finally:
+            lax.scan = orig_scan
+
     # --- forward only: G fwd + D fwd on real and fake (no grads, no Adam) --
     @jax.jit
     def many_fwd(state, images, zs, scales):
@@ -208,7 +242,7 @@ def main() -> None:
 
     flops = bytes_accessed = None
     try:
-        ca = compiled.cost_analysis()
+        ca = compiled_for_cost.cost_analysis()
         ca = ca[0] if isinstance(ca, (list, tuple)) else ca
         flops = ca.get("flops")
         bytes_accessed = ca.get("bytes accessed")
@@ -231,6 +265,10 @@ def main() -> None:
         "g_forward_ms": round(gen_ms, 4),
         "adam_ms": round(adam_ms, 4),
     }
+    if scan_trips:
+        # stamp the rows so capture_all's tables can distinguish trip-exact
+        # counts (this build onward) from pre-fix counted-once captures
+        summary["scan_trips"] = scan_trips
     if flops:
         summary["flops_per_step"] = flops
         summary["tflops_effective"] = round(flops / (step_ms * 1e-3) / 1e12,
