@@ -377,10 +377,37 @@ class TrainConfig:
     nan_policy: str = "abort"      # what a tripped NaN gate does: "abort"
                                    # (reference parity: raise with step
                                    # context) | "rollback" (fail-operational:
-                                   # restore the last-good host snapshot,
-                                   # skip the offending batch window, keep
-                                   # training — train/rollback.py;
-                                   # single-process runs only)
+                                   # restore the last-good snapshot, skip
+                                   # the offending batch window, keep
+                                   # training — train/rollback.py. Multi-
+                                   # host: gate verdicts are allgathered so
+                                   # every process takes the same branch,
+                                   # and the snapshot is a sharded device-
+                                   # resident copy restored collectively —
+                                   # train/coordination.py)
+    coord_stop: bool = True        # multi-host: SIGTERM/SIGINT on ANY host
+                                   # sets a local flag that is allgathered
+                                   # at each step boundary, so the whole
+                                   # job breaks together and runs the
+                                   # collective final save (a preemption
+                                   # notice becomes a resumable stop). One
+                                   # tiny int32 allgather per step boundary
+                                   # is the cost. False restores PR 3
+                                   # semantics: default signal handling,
+                                   # restart from the last periodic save.
+                                   # Single-process stop handling is always
+                                   # on and collective-free either way
+    collective_timeout_secs: float = 0.0  # >0 arms the hung-collective
+                                   # watchdog (train/coordination.py): a
+                                   # daemon thread deadlines each dispatch/
+                                   # save/consensus section and, on expiry,
+                                   # dumps per-process stacks and exits
+                                   # nonzero (43) so the launcher restarts
+                                   # the job instead of hanging forever.
+                                   # Set comfortably above the slowest
+                                   # legitimate section (collective save
+                                   # included; the first step's compile is
+                                   # exempted). 0 = off
     rollback_snapshot_steps: int = 100  # nan_policy="rollback": keep a host-
                                    # side copy of the last gate-verified
                                    # state every K steps (the restore point;
@@ -517,6 +544,10 @@ class TrainConfig:
             raise ValueError(
                 f"rollback_lr_backoff must be in (0, 1], got "
                 f"{self.rollback_lr_backoff}")
+        if self.collective_timeout_secs < 0:
+            raise ValueError(
+                f"collective_timeout_secs must be >= 0, got "
+                f"{self.collective_timeout_secs}")
         if self.max_corrupt_records < 0:
             raise ValueError(
                 f"max_corrupt_records must be >= 0, got "

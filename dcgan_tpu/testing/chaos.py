@@ -19,6 +19,22 @@ Injection points in production code:
 - `should_crash_worker(n)`   train/services.py worker: raises before
   executing the `services_worker_crash`-th task (1-based) — exercises the
   dispatch-thread error surfacing contract.
+- `maybe_self_signal(step)`  trainer step boundary: delivers SIGTERM to this
+  process once at `sigterm_at_step` — exercises the coordinated preemption
+  stop without a racy cross-process kill().
+- `maybe_hang(step)`         inside the trainer's watchdog-guarded dispatch
+  section: sleeps `hang_secs` once at `hang_at_step`, simulating a process
+  that never joins the next collective — the other processes block in a
+  real allgather/allreduce and the hung-collective watchdog must trip on
+  every process.
+
+Multi-process plans (ISSUE 4): when the DCGAN_CHAOS JSON object's keys are
+all digit strings, it is a PER-PROCESS map `{"<pid>": {fields...}}` selected
+by the `MH_PID` environment variable (the id the multihost harnesses —
+tests/multihost_worker.py and tools/chaos_drill.py — already export per
+subprocess; absent means "0"). A process with no entry gets no plan, so one
+env value arms a fault on exactly one host of a multi-host job — the shape
+every coordinated-recovery drill needs.
 
 Disk faults (`corrupt_record`, `truncate_checkpoint`) are properties of the
 bytes on disk, not of running code, so the plan only CARRIES them for the
@@ -54,6 +70,13 @@ class FaultPlan:
                                    # raises one OSError
     services_worker_crash: int = 0  # >0: services worker raises before its
                                     # n-th task (1-based)
+    sigterm_at_step: int = 0       # >0: deliver SIGTERM to this process at
+                                   # that trainer step boundary (once)
+    hang_at_step: int = 0          # >0: sleep hang_secs at that step
+                                   # boundary (once) — a peer that never
+                                   # joins the next collective
+    hang_secs: float = 3600.0      # how long hang_at_step sleeps (far past
+                                   # any sane collective_timeout_secs)
     _fired: Set[str] = dataclasses.field(default_factory=set)
 
     def fire_once(self, name: str) -> bool:
@@ -69,13 +92,28 @@ _plan_loaded = False
 
 
 def plan_from_env(env=None) -> Optional[FaultPlan]:
-    """Parse DCGAN_CHAOS (JSON object of FaultPlan fields), or None."""
-    raw = (env if env is not None else os.environ).get(ENV_VAR, "")
+    """Parse DCGAN_CHAOS, or None.
+
+    Flat JSON object of FaultPlan fields = one plan for this process.
+    All-digit keys = per-process map selected by MH_PID (no entry for this
+    process = no plan armed here).
+    """
+    environ = env if env is not None else os.environ
+    raw = environ.get(ENV_VAR, "")
     if not raw:
         return None
+    d = json.loads(raw)
+    if d and all(isinstance(k, str) and k.isdigit() for k in d):
+        pid = environ.get("MH_PID", "0")
+        d = d.get(pid)
+        if d is None:
+            return None
+        if not isinstance(d, dict):
+            raise ValueError(
+                f"per-process {ENV_VAR} entry for pid {pid} must be an "
+                f"object of FaultPlan fields, got {d!r}")
     fields = {f.name for f in dataclasses.fields(FaultPlan)
               if not f.name.startswith("_")}
-    d = json.loads(raw)
     unknown = sorted(set(d) - fields)
     if unknown:
         raise ValueError(f"unknown {ENV_VAR} fault(s) {unknown}; "
@@ -129,6 +167,31 @@ def should_crash_worker(task_index: int) -> bool:
     return bool(plan and plan.services_worker_crash
                 and task_index >= plan.services_worker_crash
                 and plan.fire_once("services_worker_crash"))
+
+
+def maybe_self_signal(step: int) -> None:
+    """Deliver SIGTERM to this process once at `sigterm_at_step` — the
+    deterministic stand-in for a preemption notice landing on one host."""
+    import signal
+
+    plan = active_plan()
+    if plan and plan.sigterm_at_step and step == plan.sigterm_at_step \
+            and plan.fire_once("sigterm_at_step"):
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_hang(step: int) -> None:
+    """Sleep `hang_secs` once at `hang_at_step`: this process goes silent
+    inside the trainer's watchdog-guarded section while its peers block in
+    a real collective it never joins."""
+    import time
+
+    plan = active_plan()
+    if plan and plan.hang_at_step and step == plan.hang_at_step \
+            and plan.fire_once("hang_at_step"):
+        print(f"[dcgan_tpu] chaos: hanging process for {plan.hang_secs:.0f}s "
+              f"at step {step}", flush=True)
+        time.sleep(plan.hang_secs)
 
 
 # -- disk-fault helpers (drill/tests only; never called by production) -------

@@ -170,10 +170,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--nan_policy", choices=["abort", "rollback"],
                    default="abort",
                    help="tripped NaN gate: abort with step context "
-                        "(reference parity) or restore the last-good host "
+                        "(reference parity) or restore the last-good "
                         "snapshot, skip the offending batch window, and "
-                        "keep training (single-process; bounded by "
-                        "--max_rollbacks)")
+                        "keep training (bounded by --max_rollbacks). "
+                        "Multi-host: gate verdicts are allgathered so every "
+                        "process takes the same branch, and the snapshot "
+                        "is a sharded device-resident copy")
+    p.add_argument("--coord_stop", type=_parse_bool, default=True,
+                   metavar="{true,false}",
+                   help="multi-host: SIGTERM/SIGINT on any host is "
+                        "allgathered at each step boundary so the whole "
+                        "job stops together through the collective final "
+                        "save (a preemption notice becomes a resumable "
+                        "stop); false = default signal semantics, restart "
+                        "from the last periodic save")
+    p.add_argument("--collective_timeout_secs", type=float, default=0.0,
+                   help=">0 arms the hung-collective watchdog: a deadline "
+                        "around each dispatch/save/consensus section that "
+                        "dumps per-process stacks and exits nonzero on "
+                        "expiry so the launcher restarts the job instead "
+                        "of hanging; 0 = off")
     p.add_argument("--rollback_snapshot_steps", type=int, default=100,
                    help="with --nan_policy rollback: host-snapshot the "
                         "gate-verified state every K steps (the restore "
@@ -273,6 +289,8 @@ _FLAG_FIELDS = {
     "log_every_steps": ("", "log_every_steps"),
     "nan_check_steps": ("", "nan_check_steps"),
     "nan_policy": ("", "nan_policy"),
+    "coord_stop": ("", "coord_stop"),
+    "collective_timeout_secs": ("", "collective_timeout_secs"),
     "rollback_snapshot_steps": ("", "rollback_snapshot_steps"),
     "max_rollbacks": ("", "max_rollbacks"),
     "rollback_lr_backoff": ("", "rollback_lr_backoff"),

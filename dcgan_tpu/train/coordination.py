@@ -1,0 +1,315 @@
+"""Multi-host coordination: the primitives that turn per-process recovery
+decisions into deterministic collective agreements (ISSUE 4 tentpole).
+
+PR 3's fail-operational layer was single-process by construction: every
+recovery branch was a local `if`, and a local `if` on one host of a
+multi-host job is a deadlock generator — the other hosts keep dispatching
+collectives the branching host never joins. ParaGAN (PAPERS.md, arxiv
+2411.03999) and the pjit/TPUv4 scaling work (arxiv 2204.06514) both land on
+the same discipline this module implements: any decision that changes which
+collectives run next must itself be a collective, taken at a step boundary
+every process reaches, and every blocking collective needs a deadline so a
+lost peer fails the job fast instead of hanging it forever.
+
+Three primitives, each a cheap no-op in single-process runs:
+
+- `anomaly_consensus(local_bad)` — allgathers each process's NaN-gate
+  verdict (one int32 per process) so all hosts take the identical
+  abort/rollback branch, even when the non-finite value is visible on one
+  host only (a host-side readback fault, or a per-process chaos plan).
+- `CoordinatedStop` — SIGTERM/SIGINT on *any* host sets a process-local
+  flag; `poll()` allgathers the flags at each step boundary, so the whole
+  job agrees to break together and runs the existing *collective* final
+  save. This is what makes a TPU-VM preemption notice a resumable stop on
+  real topologies — PR 3 had to skip signal handling entirely under
+  multi-host because a one-host save would deadlock the collective.
+- `CollectiveWatchdog` — a daemon thread arms a deadline around each
+  dispatch/save/consensus section; on expiry it dumps per-process
+  diagnostics (process index, step, phase, every thread's live stack) to
+  stderr and exits nonzero (`WATCHDOG_EXIT_CODE`) so the supervising
+  launcher restarts the job from the last checkpoint instead of burning
+  accelerator-hours in a hung allreduce.
+
+Testability: the collective transport is the module-level `_allgather_i32`
+(tests shim it together with `jax.process_count` — no subprocess needed),
+and the watchdog takes an `on_trip` hook so units can observe a trip
+without the process exiting.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+# Distinct from any Python/launcher default so a supervisor (and the chaos
+# drill) can attribute the exit to the watchdog specifically.
+WATCHDOG_EXIT_CODE = 43
+
+
+def _allgather_i32(value: int) -> np.ndarray:
+    """One int32 from every process, index-ordered. The single collective
+    primitive everything here is built from — kept module-level so tests
+    can shim the transport without a real multi-process job."""
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray(value, np.int32))
+    return np.asarray(gathered).reshape(-1)
+
+
+def anomaly_consensus(local_bad: bool) -> Tuple[bool, List[int]]:
+    """Agree on the NaN-gate verdict: (any process tripped, which ones).
+
+    Every process must call this at the same gate invocation (the gate
+    cadence is step-keyed, so they do); the return value is identical on
+    every process, which is what keeps the downstream abort/rollback
+    branch — and every collective it issues — mesh-consistent.
+    """
+    if jax.process_count() == 1:
+        return bool(local_bad), [0] if local_bad else []
+    gathered = _allgather_i32(int(bool(local_bad)))
+    return bool(gathered.any()), [int(i) for i in np.nonzero(gathered)[0]]
+
+
+class CoordinatedStop:
+    """Signal-flag consensus for a resumable whole-job stop.
+
+    `install()` registers one-shot SIGTERM/SIGINT handlers that only set a
+    process-local flag (async-signal-safe; the handler restores default
+    semantics on first delivery so a second signal can still kill a hung
+    final save). `poll()` runs at each step boundary on every process:
+    single-process it reads the local flag; multi-host it allgathers the
+    flags, so the job breaks in unison and the final save stays a valid
+    collective. Handlers are installed only on the main thread (signal
+    module constraint) and restored by `restore()` in the trainer's
+    finally block.
+    """
+
+    def __init__(self) -> None:
+        self._signal_num: Optional[int] = None
+        self._restore: dict = {}
+
+    def install(self) -> None:
+        if threading.current_thread() is not threading.main_thread():
+            return
+        def _on_signal(signum, frame):
+            self._signal_num = signum
+            for sig, handler in self._restore.items():
+                signal.signal(sig, handler)
+
+        for s in (signal.SIGTERM, signal.SIGINT):
+            self._restore[s] = signal.signal(s, _on_signal)
+
+    def restore(self) -> None:
+        for s, h in self._restore.items():
+            signal.signal(s, h)
+        self._restore.clear()
+
+    @property
+    def local_signal(self) -> Optional[int]:
+        return self._signal_num
+
+    def poll(self) -> Tuple[Optional[int], List[int]]:
+        """(agreed stop signal or None, processes that raised it).
+
+        Multi-host this is one tiny allgather per step boundary — the
+        price of never letting one host break out of a collective loop
+        alone. The gathered value is identical on every process, so either
+        the whole job breaks or none of it does.
+        """
+        local = self._signal_num or 0
+        if jax.process_count() == 1:
+            return (self._signal_num, [0] if self._signal_num else [])
+        gathered = _allgather_i32(local)
+        if not gathered.any():
+            return None, []
+        # a deterministic representative signal (the max: SIGTERM beats
+        # SIGINT) so every process logs/acts identically
+        return (int(gathered.max()),
+                [int(i) for i in np.nonzero(gathered)[0]])
+
+
+class CollectiveWatchdog:
+    """Deadline guard for sections that block on mesh-wide collectives.
+
+    `guard(phase, step)` arms a deadline for the enclosed section and
+    disarms it on exit. Expiry means some process never joined the
+    collective this one is blocked in. TWO enforcement layers, because a
+    hung runtime call does not reliably release the GIL:
+
+    - a daemon thread checks the armed deadline every `poll_interval`
+      seconds; on expiry it prints a diagnostic header (process, step,
+      phase, seconds stuck), dumps every thread's live stack via
+      faulthandler, and `os._exit`s with WATCHDOG_EXIT_CODE — the
+      informative path, needs the GIL to run;
+    - `faulthandler.dump_traceback_later` armed at `timeout_secs * 1.5 + 2`
+      as the GIL-immune backstop: its timer lives in C, so even a blocked
+      call that never yields the interpreter still gets its stacks dumped
+      and the process exits nonzero (status 1 — faulthandler's fixed code).
+
+    Either way the job dies loudly with per-process stack context instead
+    of hanging forever; a restart from the last checkpoint is strictly
+    better than an accelerator pod wedged in a dead allreduce.
+
+    `on_trip(phase, step)` replaces both enforcement layers for unit tests.
+    """
+
+    def __init__(self, timeout_secs: float, *,
+                 poll_interval: Optional[float] = None,
+                 on_trip: Optional[Callable[[str, int], None]] = None):
+        if timeout_secs <= 0:
+            raise ValueError(
+                f"timeout_secs must be > 0, got {timeout_secs}")
+        self.timeout_secs = timeout_secs
+        self._backstop_secs = timeout_secs * 1.5 + 2.0
+        self._poll = poll_interval if poll_interval is not None \
+            else max(0.05, min(1.0, timeout_secs / 4))
+        self._on_trip = on_trip
+        self._lock = threading.Lock()
+        self._deadline: Optional[float] = None
+        self._phase = ""
+        self._step = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="dcgan-collective-watchdog", daemon=True)
+        self._thread.start()
+
+    def _set_backstop(self, seconds: Optional[float]) -> None:
+        """(Re)arm or cancel the C-level faulthandler timer. Process-global
+        by nature — one watchdog instance per process, which the trainer
+        guarantees."""
+        if self._on_trip is not None:
+            return  # unit tests must not arm a process-killing timer
+        if seconds is None:
+            faulthandler.cancel_dump_traceback_later()
+        else:
+            faulthandler.dump_traceback_later(
+                max(0.1, seconds), repeat=False, file=sys.stderr, exit=True)
+
+    def arm(self, phase: str, step: int) -> tuple:
+        """Start (or refresh) the deadline; returns the previous
+        (deadline, phase, step) so nested guards can restore it."""
+        with self._lock:
+            prev = (self._deadline, self._phase, self._step)
+            self._deadline = time.monotonic() + self.timeout_secs
+            self._phase = phase
+            self._step = int(step)
+            self._set_backstop(self._backstop_secs)
+            return prev
+
+    def disarm(self) -> None:
+        with self._lock:
+            self._deadline = None
+            self._set_backstop(None)
+
+    def _restore(self, prev: tuple) -> None:
+        with self._lock:
+            self._deadline, self._phase, self._step = prev
+            self._set_backstop(
+                None if self._deadline is None
+                else max(0.1, self._deadline - time.monotonic())
+                + (self._backstop_secs - self.timeout_secs))
+
+    def guard(self, phase: str, step: int) -> "_WatchdogGuard":
+        return _WatchdogGuard(self, phase, step)
+
+    def close(self) -> None:
+        self.disarm()
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+    # -- watchdog thread -----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll):
+            with self._lock:
+                deadline, phase, step = self._deadline, self._phase, \
+                    self._step
+            if deadline is None or time.monotonic() < deadline:
+                continue
+            if self._on_trip is not None:
+                self._on_trip(phase, step)
+                self.disarm()  # a test hook keeps the process alive
+                continue
+            self._dump_and_exit(phase, step)
+
+    def _dump_and_exit(self, phase: str, step: int) -> None:
+        try:
+            print(f"[dcgan_tpu] hung-collective watchdog: process "
+                  f"{jax.process_index()} stuck > {self.timeout_secs:.1f}s "
+                  f"in phase {phase!r} at step {step} — dumping all thread "
+                  f"stacks and exiting {WATCHDOG_EXIT_CODE} so the job "
+                  f"restarts from the last checkpoint instead of hanging",
+                  file=sys.stderr, flush=True)
+            faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
+            sys.stderr.flush()
+        finally:
+            os._exit(WATCHDOG_EXIT_CODE)
+
+
+class _WatchdogGuard:
+    """Arms on enter, RESTORES the previous arm state on exit — so a short
+    guarded collective (the NaN-consensus allgather) nested inside a longer
+    guarded section (the step dispatch/consume window) hands the deadline
+    back instead of silently disarming the outer section."""
+
+    __slots__ = ("_wd", "_phase", "_step", "_prev")
+
+    def __init__(self, wd: CollectiveWatchdog, phase: str, step: int):
+        self._wd = wd
+        self._phase = phase
+        self._step = step
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = self._wd.arm(self._phase, self._step)
+        return self
+
+    def __exit__(self, *exc):
+        self._wd._restore(self._prev)
+        return False
+
+
+class _NullWatchdog:
+    """`collective_timeout_secs=0`: every guard is a free no-op."""
+
+    class _Guard:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    _GUARD = _Guard()
+
+    def arm(self, phase: str, step: int) -> None:
+        pass
+
+    def disarm(self) -> None:
+        pass
+
+    def guard(self, phase: str, step: int):
+        return self._GUARD
+
+    def close(self) -> None:
+        pass
+
+
+#: A ready-made no-op guard for call sites that decide per-invocation
+#: whether a section should run under the deadline (the trainer suppresses
+#: arming until the mesh is proven warm — see `_guard` there).
+NULL_GUARD = _NullWatchdog._GUARD
+
+
+def make_watchdog(timeout_secs: float, **kw):
+    """The trainer's one switch between a real deadline and the no-op."""
+    return CollectiveWatchdog(timeout_secs, **kw) if timeout_secs > 0 \
+        else _NullWatchdog()

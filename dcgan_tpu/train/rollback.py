@@ -7,20 +7,31 @@ wasteful for a multi-day run where one pathological batch (or one cosmic-ray
 bit) poisons a step that a different batch window would have sailed through
 (ParaGAN's recovery argument for long GAN runs, PAPERS.md arxiv 2411.03999).
 
-`--nan_policy rollback` keeps a HOST-side copy of the last gate-verified
-state every `rollback_snapshot_steps` steps; when the gate trips, the
-manager puts the snapshot back on device (same shardings), rewinds the
-host's step counter, and training continues — the data iterator is NOT
-rewound, so the batches that fed the poisoned window are naturally skipped,
-and the trainer folds the rollback count into its step-key stream so the
-replayed steps also draw fresh z (a bitwise replay would deterministically
-re-diverge). Optional LR backoff multiplies both nets' base rates per
-rollback. `max_rollbacks` bounds the whole mechanism: persistent divergence
-is a real bug and must still abort.
+`--nan_policy rollback` keeps a copy of the last gate-verified state every
+`rollback_snapshot_steps` steps; when the gate trips, the manager puts the
+snapshot back, rewinds the host's step counter, and training continues —
+the data iterator is NOT rewound, so the batches that fed the poisoned
+window are naturally skipped, and the trainer folds the rollback count into
+its step-key stream so the replayed steps also draw fresh z (a bitwise
+replay would deterministically re-diverge). Optional LR backoff multiplies
+both nets' base rates per rollback. `max_rollbacks` bounds the whole
+mechanism: persistent divergence is a real bug and must still abort.
 
-Host snapshots require fully-addressable arrays, so the policy is
-single-process only (the trainer validates); multi-host keeps abort, whose
-restart-from-checkpoint path is already collective-safe.
+Two snapshot representations, same restore contract:
+
+- host (`device_resident=False`, single-process default): a host copy via
+  `jax.device_get`, put back with the captured shardings. Zero extra HBM;
+  requires every leaf to be fully addressable from this process.
+- sharded device-resident (`device_resident=True`, the multi-host mode —
+  ISSUE 4): a jitted identity copy keeps each host's *addressable shards*
+  on its own devices, restored through the same jitted copy so the
+  returned buffers are fresh (the step's donate_argnums invalidates only
+  the arrays actually passed in — the snapshot survives to serve a second
+  rollback). No process ever holds the full state, which is exactly what
+  unblocked multi-host rollback: the snapshot/restore dispatches run on
+  every process at the same consensus-agreed point, so they are ordinary
+  mesh-consistent programs. Costs one extra copy of the train state in
+  device memory — the price of a restore that needs no host gather.
 
 Accounting: `rollbacks` is surfaced as the `anomaly/rollbacks` scalar
 through utils/metrics.MetricWriter — one event at each rollback plus the
@@ -41,17 +52,36 @@ class RollbackExhausted(FloatingPointError):
     gate failure as __cause__."""
 
 
+_DEVICE_COPY = None
+
+
+def device_copy(tree: Pytree) -> Pytree:
+    """Fresh device buffers with the same values/shardings: `a + 0` under
+    jit compiles to a copy whose outputs alias nothing a later step program
+    can donate. One module-level jitted identity shared by every caller
+    (the rollback snapshot here, the trainer's param-histogram capture) —
+    jax caches per tree structure/shape, so one function serves them all,
+    and a future fix to the copy idiom lands in one place."""
+    global _DEVICE_COPY
+    if _DEVICE_COPY is None:
+        _DEVICE_COPY = jax.jit(
+            lambda t: jax.tree_util.tree_map(lambda a: a + 0, t))
+    return _DEVICE_COPY(tree)
+
+
 class RollbackManager:
     """Last-good snapshot keeper + restore executor for one training run."""
 
     def __init__(self, *, every: int, max_rollbacks: int,
-                 lr_backoff: float = 1.0, chief: bool = True):
+                 lr_backoff: float = 1.0, chief: bool = True,
+                 device_resident: bool = False):
         if every < 1:
             raise ValueError(f"snapshot cadence must be >= 1, got {every}")
         self.every = every
         self.max_rollbacks = max_rollbacks
         self.lr_backoff = lr_backoff
         self.chief = chief
+        self.device_resident = device_resident
         self.rollbacks = 0
         self._snap: Optional[Pytree] = None
         self._snap_step: Optional[int] = None
@@ -65,12 +95,16 @@ class RollbackManager:
         return step % self.every == 0
 
     def snapshot(self, step: int, state: Pytree) -> None:
-        """Host-copy `state` as the new restore point. The caller passes
-        only gate-verified state (the trainer forces a finiteness check at
+        """Capture `state` as the new restore point. The caller passes only
+        gate-verified state (the trainer forces a finiteness check at
         snapshot boundaries)."""
-        self._shardings = jax.tree_util.tree_map(
-            lambda x: x.sharding if hasattr(x, "sharding") else None, state)
-        self._snap = jax.device_get(state)
+        if self.device_resident:
+            self._snap = device_copy(state)
+        else:
+            self._shardings = jax.tree_util.tree_map(
+                lambda x: x.sharding if hasattr(x, "sharding") else None,
+                state)
+            self._snap = jax.device_get(state)
         self._snap_step = int(step)
 
     def restore(self, exc: FloatingPointError) -> tuple:
@@ -90,10 +124,13 @@ class RollbackManager:
                   f"last-good snapshot at step {self._snap_step} "
                   f"(rollback {self.rollbacks}/{self.max_rollbacks}, "
                   f"offending batch window will be skipped)", flush=True)
-        state = jax.tree_util.tree_map(
-            lambda host, sh: jax.device_put(host, sh)
-            if sh is not None else host,
-            self._snap, self._shardings)
+        if self.device_resident:
+            state = device_copy(self._snap)
+        else:
+            state = jax.tree_util.tree_map(
+                lambda host, sh: jax.device_put(host, sh)
+                if sh is not None else host,
+                self._snap, self._shardings)
         return state, self._snap_step
 
     def lr_scale(self) -> float:

@@ -27,6 +27,15 @@ scalars while step N+1 computes) and every expensive writer path — param/
 activation histograms, sample-grid PNGs, JSONL/TB IO — runs on the
 train/services.py background worker. `--async_services=false` restores the
 fully-inline loop.
+
+Multi-host fail-operational layer (docs/DESIGN.md §6c.1,
+train/coordination.py): every recovery decision that changes which
+collectives run next is itself a collective — NaN-gate verdicts are
+allgathered (anomaly consensus, so rollback works under multi-host with a
+sharded device-resident snapshot), a signal on any host becomes a
+whole-job coordinated stop through the collective final save
+(`--coord_stop`), and `--collective_timeout_secs` arms a watchdog that
+turns a hung collective into per-process stack dumps + a nonzero exit.
 """
 
 from __future__ import annotations
@@ -55,6 +64,7 @@ from dcgan_tpu.parallel import (
     make_parallel_train,
 )
 from dcgan_tpu.testing import chaos
+from dcgan_tpu.train import coordination
 from dcgan_tpu.train.rollback import RollbackManager
 from dcgan_tpu.train.services import make_services
 from dcgan_tpu.utils.checkpoint import Checkpointer
@@ -225,53 +235,52 @@ def _sample_data_iterator(cfg: TrainConfig, mesh, *,
     return None
 
 
-def _install_stop_handlers():
-    """Graceful shutdown (single-process only): SIGTERM/SIGINT set a flag
-    the hot loop polls, and the loop breaks at the next step boundary to
-    force a final checkpoint — a TPU-VM preemption notice becomes a
-    resumable stop. One-shot: the handler restores default semantics on
-    first delivery so a second signal can still kill a hung final save.
-    Multi-host keeps default signal semantics: save() is a collective, and
-    a handler firing on one process would deadlock the others (the job
-    restarts from the last periodic save — the reference Supervisor's
+def _install_stop_handlers(cfg: TrainConfig) -> coordination.CoordinatedStop:
+    """Graceful shutdown: SIGTERM/SIGINT set a process-local flag the hot
+    loop polls, and the loop breaks at the next step boundary to force a
+    final checkpoint — a TPU-VM preemption notice becomes a resumable
+    stop. One-shot: the handler restores default semantics on first
+    delivery so a second signal can still kill a hung final save.
+
+    Multi-host (ISSUE 4): handlers are installed only under
+    `cfg.coord_stop`, because the flag alone is not enough — save() is a
+    collective, and one process breaking out alone would deadlock the
+    others. CoordinatedStop.poll() allgathers the flags at each step
+    boundary so the whole job agrees to break together; with
+    coord_stop=False multi-host keeps PR 3's default signal semantics (the
+    job restarts from the last periodic save — the reference Supervisor's
     recovery contract, image_train.py:123-141).
 
-    Returns (stop_signal, restore_handlers); the caller restores the
-    originals in a finally block so an exception mid-run cannot leave the
-    flag-only handler installed on a process whose loop is gone."""
-    import signal
-    import threading
-
-    stop_signal = {"num": None}
-    restore_handlers = {}
-    if jax.process_count() == 1 and \
-            threading.current_thread() is threading.main_thread():
-        def _on_signal(signum, frame):
-            stop_signal["num"] = signum
-            for sig, handler in restore_handlers.items():
-                signal.signal(sig, handler)
-
-        for s in (signal.SIGTERM, signal.SIGINT):
-            restore_handlers[s] = signal.signal(s, _on_signal)
-    return stop_signal, restore_handlers
+    The caller restores the original handlers in a finally block so an
+    exception mid-run cannot leave the flag-only handler installed on a
+    process whose loop is gone."""
+    stop = coordination.CoordinatedStop()
+    if jax.process_count() == 1 or cfg.coord_stop:
+        stop.install()
+    return stop
 
 
 def train(cfg: TrainConfig, *, synthetic_data: bool = False,
           max_steps: Optional[int] = None) -> Pytree:
     """Run the training loop; returns the final state pytree."""
-    import signal
-
-    stop_signal, restore_handlers = _install_stop_handlers()
+    # form the multi-host job BEFORE deciding on signal handlers: on an
+    # env-driven bring-up (JAX_COORDINATOR_ADDRESS) process_count() is
+    # still 1 until this runs, and installing the flag-only handler on a
+    # coord_stop=False multi-host process would swallow the first SIGTERM
+    # without anyone ever polling the flag (idempotent — _train's own call
+    # is then a no-op)
+    initialize_multihost()
+    stop = _install_stop_handlers(cfg)
     try:
         return _train(cfg, synthetic_data=synthetic_data,
-                      max_steps=max_steps, stop_signal=stop_signal)
+                      max_steps=max_steps, stop=stop)
     finally:
-        for s, h in restore_handlers.items():
-            signal.signal(s, h)
+        stop.restore()
 
 
 def _train(cfg: TrainConfig, *, synthetic_data: bool,
-           max_steps: Optional[int], stop_signal: dict) -> Pytree:
+           max_steps: Optional[int],
+           stop: coordination.CoordinatedStop) -> Pytree:
     initialize_multihost()
     if cfg.fid_every_steps and jax.process_count() > 1 \
             and cfg.fid_num_samples % jax.process_count():
@@ -279,13 +288,6 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
             f"fid_num_samples ({cfg.fid_num_samples}) must divide evenly "
             f"over {jax.process_count()} processes — the in-training probe "
             "splits the sample budget per process (VERDICT r2 #5)")
-    if cfg.nan_policy == "rollback" and jax.process_count() > 1:
-        raise ValueError(
-            "nan_policy='rollback' is single-process only: the last-good "
-            "snapshot is a host copy of the full state, which multi-host "
-            "processes cannot address. Multi-host runs keep nan_policy="
-            "'abort' — the Supervisor-style restart-from-checkpoint path "
-            "is already collective-safe.")
     mesh = make_mesh(cfg.mesh)
     pt = make_parallel_train(cfg, mesh)
     chief = is_chief()
@@ -335,16 +337,22 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                   f"{int(jax.device_get(state['step']))}")
 
     # NaN rollback-and-skip (train/rollback.py): under nan_policy="rollback"
-    # a host-side last-good snapshot is refreshed every K steps and a gate
-    # trip restores it instead of aborting; None under the default policy —
-    # the snapshot cost (one full-state device_get per K steps) is strictly
-    # opt-in.
+    # a last-good snapshot is refreshed every K steps and a gate trip
+    # restores it instead of aborting; None under the default policy — the
+    # snapshot cost is strictly opt-in. Single-process keeps the host copy
+    # (zero extra HBM); multi-host keeps a sharded DEVICE-RESIDENT copy —
+    # each process holds only its addressable shards, and the jitted
+    # snapshot/restore copies run on every process at the same
+    # consensus-agreed point (ISSUE 4: the decision to take this branch is
+    # itself allgathered in _nan_gate, so the dispatches stay
+    # mesh-consistent).
     rollback = None
     if cfg.nan_policy == "rollback":
         rollback = RollbackManager(every=cfg.rollback_snapshot_steps,
                                    max_rollbacks=cfg.max_rollbacks,
                                    lr_backoff=cfg.rollback_lr_backoff,
-                                   chief=chief)
+                                   chief=chief,
+                                   device_resident=jax.process_count() > 1)
 
     # fixed z for comparable sample grids across the run — drawn once, like
     # the reference's graph-build-time sample_z (image_train.py:77)
@@ -475,33 +483,57 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
     svc = make_services(cfg.async_services)
     deferred = cfg.async_services
 
+    # Hung-collective watchdog (train/coordination.py; off at the default
+    # collective_timeout_secs=0): a deadline around each dispatch/consume
+    # window, consensus allgather, and collective save. Expiry dumps every
+    # thread's stack with step+phase context and exits nonzero so the
+    # launcher restarts the job from the last checkpoint instead of letting
+    # one lost peer hang the whole pod forever. The first loop iteration's
+    # dispatch is exempt (it compiles); the FID probe and sample/summarize
+    # telemetry tails are deliberately unguarded (legitimately long or
+    # droppable — not the collectives that wedge a mesh).
+    watchdog = coordination.make_watchdog(cfg.collective_timeout_secs)
+
+    # The watchdog must not arm until the mesh is PROVEN warm: compile
+    # time is per-process, so right after THIS process's first dispatch a
+    # guarded collective can legitimately block for however long the
+    # SLOWEST peer's compile takes (startup skew), and a deadline there
+    # would kill a healthy job. "Warm" = proof that every peer is past its
+    # first compile: the first metric readback completing (_host_vals) or
+    # a boundary-N>0 stop poll returning (each device stream runs that
+    # allgather only after its step program). Single-process has no peer
+    # skew to wait out.
+    mesh_warm = n_proc == 1
+
+    def _guard(phase: str, step: int):
+        """A watchdog guard that is a free no-op until the mesh is warm."""
+        return watchdog.guard(phase, step) if mesh_warm \
+            else coordination.NULL_GUARD
+
     def _stage(tree) -> None:
         """Start D2H copies of a dispatched program's outputs now, so the
         background worker's device_get finds them (mostly) materialized."""
         for leaf in jax.tree_util.tree_leaves(tree):
             leaf.copy_to_host_async()
 
-    _param_snap_fn = None
-
     def _snapshot_params(params):
         """A capture of `params` that survives the next step's buffer
         donation, for the background histogram writer.
 
-        Single-process: a device-side copy — one async dispatch producing
-        fresh buffers (pt.step's donate_argnums only invalidates the
-        ORIGINAL leaves), which the worker device_gets while the next
-        steps run. Multi-process: a synchronous device_get on the dispatch
-        thread — the copy program would be a mesh-wide dispatch, and the
-        histogram tick is chief-only + wall-clock-gated, so dispatching it
-        from one process would wedge the other processes' collective
-        queues (same reason the FID probe stays on this thread); only the
-        histogram reduction + file IO move to the worker there."""
-        nonlocal _param_snap_fn
+        Single-process: a device-side copy (rollback.device_copy — the
+        same jitted identity the snapshot manager uses) producing fresh
+        buffers (pt.step's donate_argnums only invalidates the ORIGINAL
+        leaves), which the worker device_gets while the next steps run.
+        Multi-process: a synchronous device_get on the dispatch thread —
+        the copy program would be a mesh-wide dispatch, and the histogram
+        tick is chief-only + wall-clock-gated, so dispatching it from one
+        process would wedge the other processes' collective queues (same
+        reason the FID probe stays on this thread); only the histogram
+        reduction + file IO move to the worker there."""
         if deferred and n_proc == 1:
-            if _param_snap_fn is None:
-                _param_snap_fn = jax.jit(
-                    lambda t: jax.tree_util.tree_map(lambda a: a + 0, t))
-            snap = _param_snap_fn(params)
+            from dcgan_tpu.train.rollback import device_copy
+
+            snap = device_copy(params)
             _stage(snap)
             return snap
         return jax.device_get(params)
@@ -513,9 +545,13 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
         issue a device round-trip each (~0.65 ms/step measured over a
         high-latency transport, tools/bench_trainer_loop.py's 3.75 vs
         3.09 ms/step gap)."""
+        nonlocal mesh_warm
         if p.get("host") is None:
             p["host"] = {k: float(v) for k, v in
                          jax.device_get(p["metrics"]).items()}
+            # a completed cross-process readback is the warm proof the
+            # watchdog gating waits for (see mesh_warm above)
+            mesh_warm = True
         return p["host"]
 
     def _health_extras() -> dict:
@@ -531,12 +567,18 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
         return out
 
     def _nan_gate(p: dict, *, force: bool = False) -> None:
-        """Numerical-health gate (SURVEY.md §5): every process checks the
-        same replicated values, so a NaN/Inf trips the whole job in unison
-        with step context. `force` ignores the cadence — the rollback
-        manager uses it to certify a snapshot candidate even off-cadence.
-        testing/chaos.py can poison THIS view of the metrics (once) to
-        drill the recovery path without real divergence."""
+        """Numerical-health gate (SURVEY.md §5) with anomaly CONSENSUS
+        (ISSUE 4): each process computes a local verdict over its view of
+        the replicated metrics, then the verdicts are allgathered so every
+        host takes the identical abort/rollback branch — a non-finite
+        value visible on one host only (host-side readback fault, or a
+        per-process chaos plan) must never leave the others dispatching
+        collectives into a dead mesh. The gate cadence is step-keyed, so
+        every process enters the consensus collective at the same
+        invocation; `force` (the rollback manager certifying a snapshot
+        candidate off-cadence) is step-keyed too. testing/chaos.py can
+        poison THIS process's view of the metrics (once) to drill the
+        consensus path without real divergence."""
         s = p["step"]
         if not force and not (cfg.nan_check_steps
                               and s % cfg.nan_check_steps == 0):
@@ -544,9 +586,14 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
         vals = dict(_host_vals(p))
         if chaos.should_inject_nan(s):
             vals["d_loss"] = float("nan")
-        if not all(np.isfinite(v) for v in vals.values()):
+        local_bad = not all(np.isfinite(v) for v in vals.values())
+        with _guard("nan-consensus", s):
+            bad, trippers = coordination.anomaly_consensus(local_bad)
+        if bad:
+            where = f" (tripped on process(es) {trippers})" \
+                if n_proc > 1 else ""
             err = FloatingPointError(
-                f"non-finite training metrics at step {s}: "
+                f"non-finite training metrics at step {s}{where}: "
                 f"{vals} — inspect the last checkpoint in "
                 f"{cfg.checkpoint_dir}")
             err.step = s
@@ -590,6 +637,16 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
         """
         nonlocal state, step_num, pending, pt, base_key
         fail_step = getattr(e, "step", step_num)
+        # recovery's COLLECTIVE half stays under the watchdog: the
+        # device-resident restore dispatches and delete_steps_after's
+        # named barrier are exactly the blocking points where a wedged
+        # peer would otherwise hang every host with no process dying for
+        # the coordination service to notice. (The jitted copy was already
+        # compiled at snapshot time, so no compile runs in this window.)
+        # Only the optional pt rebuild below — a real recompile — is
+        # exempted.
+        if mesh_warm:
+            watchdog.arm("rollback-restore", fail_step)
         state, step_num = rollback.restore(e)
         pending = None
         # checkpoint_dir/best is deliberately NOT dropped: its retention is
@@ -605,6 +662,7 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
             svc.submit(lambda s=fail_step, n=rollback.rollbacks:
                        writer.write_scalars(s, {"anomaly/rollbacks": n}),
                        tag="anomaly")
+        watchdog.disarm()  # collectives done; the rebuild below compiles
         if rollback.lr_backoff < 1.0:
             scale = rollback.lr_scale()
 
@@ -616,6 +674,9 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                     cfg, learning_rate=cfg.learning_rate * scale,
                     d_learning_rate=_bk(cfg.d_learning_rate),
                     g_learning_rate=_bk(cfg.g_learning_rate)), mesh)
+            # the rebuilt step programs compile on their next dispatch —
+            # exempt those windows from the watchdog like the first ones
+            compiled_ks.clear()
             if chief:
                 print(f"[dcgan_tpu] rollback LR backoff: base rates "
                       f"scaled by {scale:.3g}", flush=True)
@@ -641,6 +702,10 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
     # would force a per-step host sync and serialize the pipeline.
     epoch_size = max(1, _epoch_size(cfg))  # hoisted: reads the manifest once
     step_num = start_step
+    # call shapes (steps_per_call k values) already dispatched against the
+    # CURRENT `pt` — the watchdog only arms dispatch windows for these;
+    # cleared when a rollback LR backoff rebuilds the compiled step
+    compiled_ks: set = set()
     if rollback is not None:
         # arm the initial restore point: a fresh init or a checkpoint
         # restore — both trusted (the checkpoint passed integrity
@@ -649,11 +714,36 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
     try:
         while step_num < total_steps:
             svc.raise_if_failed()  # a dead telemetry worker fails loudly
-            if stop_signal["num"] is not None:
+            chaos.maybe_self_signal(step_num)  # drill: preemption notice
+            # Coordinated stop (ISSUE 4): single-process reads the local
+            # flag; multi-host under coord_stop allgathers the flags at
+            # EVERY boundary — the decision to enter a collective must be
+            # symmetric, so it cannot be gated on the local flag alone.
+            stop_sig, stop_origins = None, []
+            if n_proc == 1:
+                stop_sig, stop_origins = stop.poll()
+            elif cfg.coord_stop:
+                with _guard("stop-consensus", step_num):
+                    stop_sig, stop_origins = stop.poll()
+                if not mesh_warm and step_num > start_step:
+                    # warm proof for NON-chief processes (which may not
+                    # materialize metrics for many steps): a boundary-N>0
+                    # poll returning means every peer dispatched its first
+                    # step — each device stream runs the allgather only
+                    # after that step's program, so everyone is past
+                    # compile
+                    mesh_warm = True
+            if stop_sig is not None:
                 if chief:
-                    print(f"[dcgan_tpu] received signal "
-                          f"{stop_signal['num']} — checkpointing at step "
-                          f"{step_num} and exiting")
+                    where = f" on process(es) {stop_origins}" \
+                        if n_proc > 1 else ""
+                    print(f"[dcgan_tpu] received signal {stop_sig}{where} "
+                          f"— checkpointing at step {step_num} and exiting")
+                # drain the services queue BEFORE the final save below: the
+                # emergency checkpoint must not outrun queued JSONL/TB
+                # events, or a post-stop inspection sees a stream truncated
+                # mid-write relative to the state that was saved
+                svc.drain()
                 break
             # steps_per_call > 1: dispatch K steps as one scanned program
             # when aligned to a K boundary with K steps remaining (a
@@ -665,6 +755,16 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
             if not (k > 1 and step_num % k == 0
                     and step_num + k <= total_steps):
                 k = 1
+            # dispatch/consume window under the watchdog deadline — except
+            # iterations that COMPILE: the first dispatch of each call
+            # shape (the k=1 tail after scanned k=K calls included), the
+            # first dispatch after a rollback LR-backoff rebuilt `pt`, and
+            # everything before the mesh is warm (a peer may still be in
+            # ITS first compile) — compile time is legitimate and
+            # unbounded by this knob
+            if mesh_warm and k in compiled_ks:
+                watchdog.arm("step-dispatch", step_num)
+            chaos.maybe_hang(step_num)  # drill: a peer that goes silent
             trace.maybe_start(step_num)
             labels = None
             if k == 1:
@@ -695,6 +795,7 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                     imgs_k = jax.numpy.stack(batches)
                     state, metrics = pt.multi_step(state, imgs_k, keys)
                     images = batches[-1]
+            compiled_ks.add(k)  # dispatch returned: this shape is compiled
             new_step = step_num + k
             cur = {"step": new_step, "metrics": metrics,
                    "write_scalars": False}
@@ -739,6 +840,7 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                            tag="histograms")
             if deferred:
                 pending = cur
+            watchdog.disarm()  # dispatch/consume window completed
 
             # per-layer activation histograms + sparsity (the reference's
             # _activation_summary channel, distriubted_model.py:75-80). The
@@ -933,21 +1035,26 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                 # and flush the lag-by-one record first so a trip here
                 # attributes to the right step. Forcing materialization
                 # costs one host sync per K steps — the snapshot's price.
+                # Guarded: the forced readback and the mesh-wide snapshot
+                # copy both block on peers (the copy compiled at the
+                # pre-loop snapshot, so no compile runs here).
                 try:
-                    _nan_gate(cur, force=True)
-                    if pending is not None:
-                        _consume_metrics(pending)
-                        pending = None
-                    rollback.snapshot(new_step, state)
+                    with _guard("snapshot-certify", new_step):
+                        _nan_gate(cur, force=True)
+                        if pending is not None:
+                            _consume_metrics(pending)
+                            pending = None
+                        rollback.snapshot(new_step, state)
                 except FloatingPointError as e:
                     _do_rollback(e)
                     continue
-            if ckpt.maybe_save(new_step, state):
-                # drain-on-checkpoint barrier: every telemetry event
-                # submitted before this checkpoint is durable before
-                # training proceeds past it — a preemption right after a
-                # save cannot lose events older than the checkpoint
-                svc.drain()
+            with _guard("collective-save", new_step):
+                if ckpt.maybe_save(new_step, state):
+                    # drain-on-checkpoint barrier: every telemetry event
+                    # submitted before this checkpoint is durable before
+                    # training proceeds past it — a preemption right after
+                    # a save cannot lose events older than the checkpoint
+                    svc.drain()
             step_num = new_step
 
         # final lag-by-one flush: the last step's NaN gate / log / scalars
@@ -964,10 +1071,24 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                   f"{svc.dropped} telemetry event(s) (training was never "
                   f"stalled for them; raise the queue bound or slow the "
                   f"summary cadence to keep them all)")
+    except BaseException:
+        # exception exit: the tail below (final save, watchdog.close())
+        # never runs, so close the enforcement thread here — a driver
+        # that catches aborts and calls train() in a loop must not
+        # accumulate one daemon thread per failed run. An explicit except
+        # (not sys.exc_info() in the finally) because train() may itself
+        # be running inside a caller's except block, where exc_info() is
+        # non-None even on a clean exit.
+        watchdog.close()
+        raise
     finally:
         # clean shutdown on EVERY exit path (normal, signal break, NaN
         # abort, loader error): stop the device-feed threads and the
-        # services worker without masking an in-flight exception
+        # services worker without masking an in-flight exception. The
+        # watchdog is DISARMED (not closed — the final collective save
+        # below still wants its deadline) so a fast abort path cannot race
+        # a stale deadline into a spurious process exit during cleanup.
+        watchdog.disarm()
         for closing in (svc, data, sample_data, fid_probe_data):
             if closing is None or not hasattr(closing, "close"):
                 continue
@@ -975,14 +1096,25 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                 closing.close()
             except Exception:
                 pass
-    trace.close()
-    writer.close()
     # final forced save at the step actually reached (== total_steps unless
     # a shutdown signal broke the loop early); skip if the periodic save
-    # already wrote this exact step
-    if ckpt.latest_step() != step_num:
-        ckpt.save(step_num, state, force=True)
-    ckpt.wait()
+    # already wrote this exact step. Guarded: this is THE collective a
+    # coordinated stop must complete on every process, and the one PR 3
+    # feared enough to skip multi-host signal handling entirely.
+    try:
+        trace.close()
+        writer.close()
+        if ckpt.latest_step() != step_num:
+            if mesh_warm:
+                watchdog.arm("final-save", step_num)
+            ckpt.save(step_num, state, force=True)
+        ckpt.wait()
+    finally:
+        # close() disarms both enforcement layers even when a closer or
+        # the save raises — a caller handling that exception must not be
+        # os._exit'd by a stale deadline mid-cleanup, nor leak the
+        # enforcement thread
+        watchdog.close()
     return state
 
 

@@ -187,13 +187,26 @@ class Checkpointer:
     def _verify_step(self, step: int) -> Tuple[bool, str]:
         """Check a finalized step dir against its manifest. No manifest =
         trusted (legacy dirs and crash-before-manifest saves keep the seed's
-        restore semantics — verification only ever adds protection)."""
+        restore semantics — verification only ever adds protection).
+
+        Every read here runs under utils/retry.retry_io: a verification
+        FAILURE permanently condemns the step (`.corrupt` rename), so a
+        transient IO blip — an NFS hiccup mid-checksum, a momentarily
+        unreadable manifest — must get its bounded retries before the
+        verdict. Only an error that SURVIVES the retries counts as
+        evidence against the bytes."""
+        from dcgan_tpu.utils.retry import retry_io
+
         path = self._manifest_path(step)
         if not os.path.exists(path):
             return True, "no integrity manifest (unverified)"
-        try:
+
+        def _read_manifest():
             with open(path) as f:
-                manifest = json.load(f)
+                return json.load(f)
+
+        try:
+            manifest = retry_io(_read_manifest, tag="ckpt-verify")
             files = manifest["files"]
         except (OSError, ValueError, KeyError) as e:
             # an unreadable manifest is a manifest-side problem, not
@@ -203,8 +216,18 @@ class Checkpointer:
         for rel, rec in files.items():
             fpath = os.path.join(step_dir, rel)
             if not os.path.exists(fpath):
+                # a manifest-listed file that is GONE is deterministic
+                # corruption (truncation/deletion) — condemn immediately
+                # rather than retry-with-backoff a FileNotFoundError and
+                # mislog it as transient
                 return False, f"missing file {rel!r}"
-            size, crc = _file_checksum(fpath)
+            try:
+                size, crc = retry_io(
+                    lambda p=fpath: _file_checksum(p), tag="ckpt-verify")
+            except FileNotFoundError:
+                return False, f"missing file {rel!r}"
+            except OSError as e:
+                return False, f"unreadable file {rel!r} ({e})"
             if size != rec["size"]:
                 return False, (f"size mismatch on {rel!r} "
                                f"({size} != {rec['size']})")
@@ -262,13 +285,49 @@ class Checkpointer:
         last-good snapshot and the gate trip may embed the divergence the
         gate only caught later (the gate runs every nan_check_steps, not
         every step), and a replayed save at the same step number would
-        collide with the stale dir. Single-process callers only — Orbax
-        deletion is not a collective here."""
+        collide with the stale dir.
+
+        Multi-host (ISSUE 4): every process calls this at the same
+        consensus-agreed rollback, but only the chief touches the shared
+        filesystem (one deleter, like the manifest writer); the others
+        wait at a named barrier so no process can dispatch a replayed save
+        into a directory the chief is still deleting, then every manager
+        drops its cached step metadata. The in-flight-save wait runs FIRST
+        and the barrier is unconditional: the disk listing below is only
+        symmetric across processes after every process has finished (and
+        Orbax has committed) its async save work — a `dropped`-gated
+        barrier could be entered by the process that listed after the
+        commit rename and skipped by the one that listed before it."""
+        multi = jax.process_count() > 1
+        self._mgr.wait_until_finished()  # never race an in-flight save
         dropped = [s for s in self._finalized_steps() if s > step]
-        if dropped:
-            self._mgr.wait_until_finished()  # never race an in-flight save
+        delete_err = None
+        if dropped and jax.process_index() == 0:
+            import shutil
+
+            from dcgan_tpu.utils.retry import retry_io
+
             for s in dropped:
-                self._mgr.delete(s)
+                if multi:
+                    # raw removal: CheckpointManager.delete is not a
+                    # collective contract across orbax versions, and the
+                    # reload below resyncs every manager anyway. A FAILED
+                    # removal must be loud (matching mgr.delete's raise on
+                    # the single-process path): a surviving poisoned-window
+                    # dir is exactly the stale-collision / unverified-
+                    # restore hazard this method exists to prevent. The
+                    # failure is RECORDED, not raised here — the chief must
+                    # still reach the verdict allgather below, or the
+                    # non-chief processes deadlock in it.
+                    try:
+                        retry_io(lambda p=os.path.join(
+                            self.directory, str(s)): shutil.rmtree(p),
+                            tag="ckpt-delete")
+                    except OSError as e:
+                        delete_err = e
+                        break
+                else:
+                    self._mgr.delete(s)
                 # the manifest must die with the step: a REPLAYED save at
                 # this step number writes different bytes, and verifying
                 # them against the stale manifest would falsely mark the
@@ -277,6 +336,32 @@ class Checkpointer:
                     os.remove(self._manifest_path(s))
                 except OSError:
                     pass
+        if multi:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            # one allgather doubles as the barrier (no process passes this
+            # point until all have entered) AND carries the chief's
+            # deletion verdict, so success/failure is decided identically
+            # on every process — an asymmetric raise above a collective is
+            # a deadlock generator
+            failed = np.asarray(multihost_utils.process_allgather(
+                np.asarray(1 if delete_err is not None else 0,
+                           np.int32))).reshape(-1)
+            try:
+                self._mgr.reload()
+            except Exception:  # older orbax: rebuild instead
+                self._mgr.close()
+                self._mgr = self._ocp.CheckpointManager(
+                    self.directory,
+                    options=self._ocp.CheckpointManagerOptions(
+                        **self._mgr_options))
+            if failed.any():
+                raise RuntimeError(
+                    f"rollback checkpoint cleanup failed on the chief "
+                    f"(steps {dropped}): aborting on every process rather "
+                    f"than replaying into a stale step dir"
+                ) from delete_err
         return dropped
 
     def restore_latest(self, target_state: Pytree) -> Optional[Pytree]:

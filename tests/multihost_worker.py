@@ -18,8 +18,9 @@ os.environ["XLA_FLAGS"] = (
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_threefry_partitionable", True)
+from dcgan_tpu.testing.multihost import configure_cpu_multiprocess  # noqa: E402
+
+configure_cpu_multiprocess(jax)
 
 
 def main() -> None:
@@ -60,6 +61,22 @@ def main() -> None:
     # (interpret mode on CPU devices), and the backward is the custom
     # grad-homing vjp (ops/pallas_attention.py::_ring_flash_vjp_bwd)
     use_pallas = os.environ.get("MH_PALLAS") == "1"
+    # MH_NAN=abort|rollback: arm the per-step NaN gate (consensus every
+    # step under multi-host) with the named policy — the parity A/B for
+    # ISSUE 4's multi-host rollback (test_multihost.py); unset keeps the
+    # default config (gate at its 100-step cadence, effectively off here)
+    nan = os.environ.get("MH_NAN", "")
+    nan_kw = {}
+    if nan:
+        # save_summaries_secs=0: every step gets a scalar row, so the A/B
+        # compares deterministic step sets — the default 10 s wall-clock
+        # throttle makes row PRESENCE timing-dependent and the comparison
+        # flaky
+        nan_kw = dict(nan_policy=nan, nan_check_steps=1,
+                      save_summaries_secs=0.0)
+        if nan == "rollback":
+            nan_kw.update(rollback_snapshot_steps=2, max_rollbacks=2,
+                          rollback_lr_backoff=0.5)
     from dcgan_tpu.config import MeshConfig
 
     cfg = TrainConfig(
@@ -87,7 +104,8 @@ def main() -> None:
         # budget splits 32/process, stats/reservoirs all-gather, every
         # process takes the best-save branch together
         fid_every_steps=2 if fid else 0,
-        fid_num_samples=64 if fid else 2048)
+        fid_num_samples=64 if fid else 2048,
+        **nan_kw)
     state = train(cfg, synthetic_data=True, max_steps=4)
     step = int(jax.device_get(state["step"]))
     print(f"MH_OK pid={jax.process_index()} step={step}", flush=True)

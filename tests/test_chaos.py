@@ -347,6 +347,23 @@ class TestCheckpointIntegrity:
         assert "retrying" in capsys.readouterr().out
         assert ck._verify_step(1) == (True, "verified")
 
+    def test_transient_verify_io_error_does_not_condemn(self, tmp_path,
+                                                        capsys):
+        """ISSUE 4 satellite: a transient OSError during verification gets
+        its bounded retries — an INTACT checkpoint must restore, not be
+        renamed `<step>.corrupt` (permanent condemnation) over one IO
+        blip."""
+        ck = self._ckpt(tmp_path)
+        ck.save(1, _tiny_state(1.0), force=True)
+        ck.wait()
+        chaos.set_plan(chaos.FaultPlan(io_error_once="ckpt-verify"))
+        restored = ck.restore_latest(_tiny_state(0.0))
+        assert int(restored["step"]) == 1
+        out = capsys.readouterr().out
+        assert "retrying" in out
+        assert not os.path.isdir(os.path.join(ck.directory, "1.corrupt"))
+        assert "failed integrity check" not in out
+
 
 class TestServicesFaults:
     def test_worker_crash_surfaces_on_dispatch_thread(self):
@@ -463,17 +480,44 @@ class TestConfigAndCLI:
         assert cfg.nan_policy == "abort"
         assert cfg.max_corrupt_records == 0
 
-    def test_rollback_multiprocess_rejected(self, tmp_path, monkeypatch):
-        from dcgan_tpu.config import ModelConfig, TrainConfig
-        from dcgan_tpu.train.trainer import train
+    def test_per_process_chaos_plan_selected_by_mh_pid(self):
+        """ISSUE 4: an all-digit-keyed DCGAN_CHAOS object is a per-process
+        map — the MH_PID process gets its entry, everyone else gets no
+        plan, so one env value arms a fault on exactly one host."""
+        env = {chaos.ENV_VAR: json.dumps({"1": {"nan_at_step": 3}}),
+               "MH_PID": "1"}
+        plan = chaos.plan_from_env(env)
+        assert plan is not None and plan.nan_at_step == 3
+        assert chaos.plan_from_env(dict(env, MH_PID="0")) is None
+        assert chaos.plan_from_env(  # no MH_PID -> pid 0 -> no entry
+            {chaos.ENV_VAR: json.dumps({"1": {"nan_at_step": 3}})}) is None
+        with pytest.raises(ValueError, match="per-process"):
+            chaos.plan_from_env({chaos.ENV_VAR: json.dumps({"1": 5}),
+                                 "MH_PID": "1"})
+        with pytest.raises(ValueError, match="unknown"):
+            chaos.plan_from_env({chaos.ENV_VAR: json.dumps(
+                {"1": {"nope": 1}}), "MH_PID": "1"})
 
-        monkeypatch.setattr(jax, "process_count", lambda: 2)
-        cfg = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8,
-                                            df_dim=8),
-                          batch_size=16, nan_policy="rollback",
-                          checkpoint_dir=str(tmp_path / "ck"))
-        with pytest.raises(ValueError, match="single-process"):
-            train(cfg, synthetic_data=True, max_steps=1)
+    def test_new_fault_hooks_are_one_shot(self, monkeypatch):
+        recorded = []
+        monkeypatch.setattr(os, "kill",
+                            lambda pid, sig: recorded.append((pid, sig)))
+        chaos.set_plan(chaos.FaultPlan(sigterm_at_step=2))
+        chaos.maybe_self_signal(1)
+        assert recorded == []
+        chaos.maybe_self_signal(2)
+        chaos.maybe_self_signal(2)  # one-shot
+        assert len(recorded) == 1
+
+        slept = []
+        import time as time_mod
+        monkeypatch.setattr(time_mod, "sleep",
+                            lambda s: slept.append(s))
+        chaos.set_plan(chaos.FaultPlan(hang_at_step=3, hang_secs=5.0))
+        chaos.maybe_hang(2)
+        chaos.maybe_hang(3)
+        chaos.maybe_hang(3)  # one-shot
+        assert slept == [5.0]
 
 
 def _tiny_cfg(tmp_path, **kw):
@@ -549,3 +593,45 @@ class TestTrainerRollbackEndToEnd:
                 rollback_snapshot_steps=2, max_rollbacks=2,
                 rollback_lr_backoff=0.5)
         assert a == b
+
+
+@pytest.mark.slow
+class TestQuarantineBaselineAcrossRuns:
+    def test_second_train_call_does_not_inherit_counts(self, tmp_path):
+        """ISSUE 4 satellite: the quarantine tally is process-global, so
+        the trainer baselines it (`corrupt_base`) at startup — run 2's
+        `data/corrupt_records` stream must report run 2's OWN corruption
+        (zero here), not run 1's leftovers."""
+        import dcgan_tpu.data.synthetic as synthetic
+        from dcgan_tpu.train.trainer import train
+
+        def events(root):
+            out = []
+            for line in open(root / "ckpt" / "events.jsonl"):
+                e = json.loads(line)
+                if e["kind"] == "scalars":
+                    out.append(e["values"])
+            return out
+
+        # run 1: one corrupt record on disk, quarantined within budget
+        data_dir = tmp_path / "data"
+        paths = synthetic.write_image_tfrecords(
+            str(data_dir), num_examples=48, image_size=16, num_shards=1)
+        chaos.corrupt_tfrecord_payload(paths[0], record_index=2)
+        root1 = tmp_path / "run1"
+        cfg = _tiny_cfg(root1, data_dir=str(data_dir),
+                        max_corrupt_records=10, shuffle_buffer=16,
+                        num_loader_threads=1, save_summaries_secs=0.0)
+        train(cfg, synthetic_data=False, max_steps=4)
+        run1_counts = [v["data/corrupt_records"] for v in events(root1)
+                       if "data/corrupt_records" in v]
+        assert run1_counts and max(run1_counts) == 1
+
+        # run 2, same process, clean synthetic data: the parity contract
+        # says the counter key must be ABSENT (it only appears nonzero),
+        # which is exactly what leaks from run 1 would violate
+        root2 = tmp_path / "run2"
+        cfg2 = _tiny_cfg(root2, max_corrupt_records=10,
+                         save_summaries_secs=0.0)
+        train(cfg2, synthetic_data=True, max_steps=4)
+        assert all("data/corrupt_records" not in v for v in events(root2))

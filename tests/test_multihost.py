@@ -11,7 +11,6 @@ chief gating, collective save) is the code multi-host TPU runs.
 """
 
 import os
-import socket
 import subprocess
 import sys
 
@@ -24,15 +23,16 @@ _WORKER = os.path.join(_REPO, "tests", "multihost_worker.py")
 
 
 def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    from dcgan_tpu.testing.multihost import free_port
+
+    return free_port()
 
 
 def _run_job(tmp_path, backend: str, *, fid: bool = False,
              steps_per_call: int = 1, spatial: int = 0,
              nproc: int = 2, local_devices: int = 4,
-             use_pallas: bool = False, timeout: float = 600) -> None:
+             use_pallas: bool = False, nan: str = "",
+             timeout: float = 600) -> None:
     """spatial: size of the spatial ("model") mesh axis (0 = pure DP);
     nproc x local_devices virtual CPU devices form the global mesh, so
     spatial > local_devices forces ring hops across process boundaries."""
@@ -51,6 +51,7 @@ def _run_job(tmp_path, backend: str, *, fid: bool = False,
             "MH_SPC": str(steps_per_call),
             "MH_SPATIAL": str(spatial),
             "MH_PALLAS": "1" if use_pallas else "0",
+            "MH_NAN": nan,
             "MH_LOCAL_DEVICES": str(local_devices),
             "PYTHONPATH": _REPO,
         })
@@ -147,6 +148,31 @@ def test_four_process_ring_flash_multihop(tmp_path):
     # ~2x under concurrent harvests, so the margin is deliberate
     _run_job(tmp_path, "gspmd", spatial=4, nproc=4, local_devices=2,
              use_pallas=True, timeout=1500)
+
+
+def test_two_process_rollback_parity_ab(tmp_path):
+    """ISSUE 4 acceptance: a rollback-ARMED no-fault multi-host run (per-
+    step gate consensus, device-resident snapshots refreshed every 2
+    steps) emits JSONL metric VALUES identical to nan_policy='abort' —
+    the whole coordination layer reads state, never perturbs it."""
+    import json
+
+    def run(name, nan):
+        root = tmp_path / name
+        root.mkdir()
+        _run_job(root, "gspmd", nan=nan)
+        rows = {}
+        for line in (root / "ckpt" / "events.jsonl").read_text() \
+                .splitlines():
+            e = json.loads(line)
+            if e["kind"] == "scalars":
+                rows[e["step"]] = {k: v for k, v in e["values"].items()
+                                   if not k.startswith("perf/")}
+        return rows
+
+    a = run("abort", "abort")
+    b = run("rollback", "rollback")
+    assert a and a == b
 
 
 @pytest.mark.skipif(os.environ.get("DCGAN_TPU_FULL_MH") != "1",

@@ -281,6 +281,36 @@ class TestChaosDrillSmoke:
                                   "services-crash"}
         assert scenarios["corrupt-record"]["corrupt_records"] >= 1
 
+    def test_multihost_smoke_passes_within_budget(self):
+        """tools/chaos_drill.py --multihost --smoke pinned into tier-1
+        (ISSUE 4): the cheapest coordinated-recovery scenario — SIGTERM on
+        one host of a real 2-process localhost-gRPC job becomes a
+        collective stop + bit-exact resume — with an explicit runtime
+        budget so the pin can never quietly eat the tier. The full
+        3-scenario matrix (coordinated rollback + watchdog trip included)
+        runs standalone: `python tools/chaos_drill.py --multihost`."""
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "tools/chaos_drill.py", "--multihost",
+             "--smoke"], cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        elapsed = time.monotonic() - t0
+        lines = [json.loads(l) for l in res.stdout.splitlines()
+                 if l.startswith("{")]
+        summary = lines[-1]
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
+        assert summary["label"] == "chaos-drill-multihost"
+        assert summary["scenarios"] == 1 and summary["failed"] == 0
+        scenarios = {p["scenario"]: p for p in lines if "scenario" in p}
+        assert set(scenarios) == {"mh-sigterm-stop"}
+        assert scenarios["mh-sigterm-stop"]["resumed"] is True
+        # runtime budget: two tiny 2-process launches; 300 s is ~4x the
+        # measured cost on a quiet host, headroom for CI contention
+        assert elapsed < 300, f"multihost smoke took {elapsed:.0f}s"
+
 
 @pytest.mark.slow
 class TestToolsRunOnCpu:

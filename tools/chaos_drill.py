@@ -28,9 +28,33 @@ never silently trains garbage, never hangs.
                           dies                           on the dispatch
                                                          thread, run aborts
 
+Multi-host matrix (ISSUE 4, `--multihost`): the same contract under a REAL
+2-process jax.distributed job over localhost gRPC (tests/multihost_worker.py
+style — each subprocess owns one virtual CPU device, faults armed on ONE
+process via the per-process DCGAN_CHAOS map keyed by MH_PID):
+
+    scenario              fault                          asserted recovery
+    --------------------  -----------------------------  --------------------
+    mh-nan-rollback       NaN into ONE process's gate    consensus spreads the
+                          view mid-run                   verdict; both hosts
+                                                         roll back together,
+                                                         complete, and end
+                                                         with IDENTICAL state
+    mh-sigterm-stop       SIGTERM delivered to host 1    stop consensus breaks
+                          only                           both hosts together
+                                                         through a collective
+                                                         final save host 0
+                                                         resumes BIT-EXACT
+    mh-watchdog           host 1 goes silent inside a    watchdog trips on
+                          collective window              every process: stack
+                                                         dumps + exit 43, no
+                                                         hang
+
 Usage:
     JAX_PLATFORMS=cpu python tools/chaos_drill.py            # full matrix
     JAX_PLATFORMS=cpu python tools/chaos_drill.py --smoke    # CI subset
+    ... --multihost                                  # 2-process matrix
+    ... --multihost --smoke                          # cheapest MH scenario
     ... --only nan-rollback truncate-checkpoint              # cherry-pick
 
 Prints one JSON row per scenario and exits nonzero if any scenario's
@@ -252,30 +276,256 @@ SCENARIOS = {
 }
 
 
+# -- multi-host scenarios (ISSUE 4) ------------------------------------------
+#
+# Two real OS processes form a jax.distributed job over localhost gRPC (one
+# virtual CPU device each — the cheapest topology that still makes every
+# save/allgather a true cross-process collective). Faults arm on process 1
+# only, through the per-process DCGAN_CHAOS map ({"1": {...}} keyed by
+# MH_PID), so every scenario proves a LOCAL fault becoming a GLOBAL,
+# deterministic decision.
+
+# cheapest multi-host scenario, pinned into tier-1 (tests/test_tools.py)
+MH_SMOKE_SCENARIOS = ("mh-sigterm-stop",)
+
+_MH_DRIVER = """
+import json, os
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=1")
+import jax
+from dcgan_tpu.testing.multihost import configure_cpu_multiprocess
+configure_cpu_multiprocess(jax)
+jax.distributed.initialize(
+    coordinator_address=os.environ["MH_COORD"],
+    num_processes=int(os.environ["MH_NPROC"]),
+    process_id=int(os.environ["MH_PID"]))
+import numpy as np
+from dcgan_tpu.config import ModelConfig, TrainConfig
+from dcgan_tpu.train.trainer import train
+cfg = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                                    compute_dtype="float32"),
+                  batch_size=8, tensorboard=False, sample_every_steps=0,
+                  activation_summary_steps=0, save_summaries_secs=1e9,
+                  log_every_steps=1, save_model_steps=10_000,
+                  **json.loads(os.environ["MH_EXTRA"]))
+state = train(cfg, synthetic_data=True,
+              max_steps=int(os.environ["MH_MAX_STEPS"]))
+total = sum(float(np.abs(np.asarray(jax.device_get(leaf),
+                                    np.float64)).sum())
+            for leaf in jax.tree_util.tree_leaves(state["params"]))
+print("STATE_SUM=%.9e" % total, flush=True)
+print("TRAIN_DONE step=%d" % int(jax.device_get(state["step"])), flush=True)
+"""
+
+
+def _free_port() -> int:
+    from dcgan_tpu.testing.multihost import free_port
+
+    return free_port()
+
+
+def _run_mh_train(extra: dict, *, max_steps: int, chaos: dict = None,
+                  nproc: int = 2, timeout: int = 600,
+                  extra_per_pid: dict = None):
+    """One 2-process trainer job; returns [(rc, output) per process].
+
+    `chaos` may be a flat FaultPlan dict (armed on every process) or a
+    per-process map like {"1": {...}} (armed on that MH_PID only).
+    `extra_per_pid` ({pid: {config overrides}}) layers per-process config
+    on top of `extra` — only for knobs that are legitimately per-process
+    (watchdog deadlines); anything steering collectives must stay common."""
+    port = _free_port()
+    procs = []
+    for pid in range(nproc):
+        cfg_extra = dict(extra, **(extra_per_pid or {}).get(pid, {}))
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   MH_COORD=f"127.0.0.1:{port}", MH_NPROC=str(nproc),
+                   MH_PID=str(pid), MH_EXTRA=json.dumps(cfg_extra),
+                   MH_MAX_STEPS=str(max_steps))
+        env.pop("DCGAN_CHAOS", None)
+        env.pop("JAX_COORDINATOR_ADDRESS", None)
+        if chaos:
+            env["DCGAN_CHAOS"] = json.dumps(chaos)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _MH_DRIVER], cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    results = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            results.append((p.returncode, out))
+    except subprocess.TimeoutExpired:
+        raise Failure(
+            f"multihost job hung past {timeout}s — the exact failure the "
+            "watchdog exists to prevent")
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return results
+
+
+def scenario_mh_nan_rollback(root: str) -> dict:
+    """NaN visible on process 1's gate only -> the allgathered verdict makes
+    BOTH hosts roll back to the same sharded device-resident snapshot; the
+    job completes and both hosts end bit-identical."""
+    results = _run_mh_train(
+        dict(checkpoint_dir=os.path.join(root, "ck"),
+             sample_dir=os.path.join(root, "sm"),
+             nan_policy="rollback", nan_check_steps=1,
+             rollback_snapshot_steps=2, max_rollbacks=2),
+        max_steps=6, chaos={"1": {"nan_at_step": 3}})
+    for pid, (rc, out) in enumerate(results):
+        _check(rc == 0, f"process {pid} failed (rc={rc}): {out[-800:]}")
+        _check("TRAIN_DONE step=6" in out,
+               f"process {pid} did not complete: {out[-400:]}")
+    chief_out = results[0][1]
+    _check("rolling back to last-good snapshot at step 2" in chief_out,
+           f"no rollback message on chief: {chief_out[-800:]}")
+    _check("process(es) [1]" in chief_out,
+           f"consensus did not attribute the trip to process 1: "
+           f"{chief_out[-800:]}")
+    sums = [next(line for line in out.splitlines()
+                 if line.startswith("STATE_SUM=")) for _, out in results]
+    _check(len(set(sums)) == 1,
+           f"post-restore states diverged across hosts: {sums}")
+    return {"rollbacks": 1, "final_step": 6, "state_sum": sums[0]}
+
+
+def scenario_mh_sigterm_stop(root: str) -> dict:
+    """SIGTERM on host 1 only -> the stop consensus breaks both hosts at
+    the same boundary, the collective final save lands, and a fresh job
+    restores it bit-exact."""
+    common = dict(checkpoint_dir=os.path.join(root, "ck"),
+                  sample_dir=os.path.join(root, "sm"))
+    results = _run_mh_train(common, max_steps=6,
+                            chaos={"1": {"sigterm_at_step": 3}})
+    for pid, (rc, out) in enumerate(results):
+        _check(rc == 0, f"process {pid} failed (rc={rc}): {out[-800:]}")
+        _check("TRAIN_DONE step=3" in out,
+               f"process {pid} did not stop at step 3: {out[-400:]}")
+    chief_out = results[0][1]
+    _check("received signal" in chief_out
+           and "on process(es) [1]" in chief_out,
+           f"chief did not log the coordinated stop: {chief_out[-800:]}")
+    _check(os.path.isdir(os.path.join(root, "ck", "3")),
+           "no collective final checkpoint at the stop step")
+    saved_sum = next(line for line in chief_out.splitlines()
+                     if line.startswith("STATE_SUM="))
+
+    # phase B: resume lands exactly on the stop step -> the printed state
+    # is the restored checkpoint, byte-for-byte the state phase A saved
+    results = _run_mh_train(common, max_steps=3)
+    for pid, (rc, out) in enumerate(results):
+        _check(rc == 0, f"resume process {pid} failed (rc={rc}): "
+                        f"{out[-800:]}")
+        _check("TRAIN_DONE step=3" in out,
+               f"resume process {pid} wrong step: {out[-400:]}")
+    _check("restored checkpoint at step 3" in results[0][1],
+           f"resume did not restore the stop checkpoint: "
+           f"{results[0][1][-800:]}")
+    restored_sum = next(line for line in results[0][1].splitlines()
+                        if line.startswith("STATE_SUM="))
+    _check(restored_sum == saved_sum,
+           f"resume is not bit-exact: saved {saved_sum}, restored "
+           f"{restored_sum}")
+    return {"stopped_at": 3, "resumed": True, "state_sum": saved_sum}
+
+
+def scenario_mh_watchdog(root: str) -> dict:
+    """Process 1 goes silent inside a collective window -> process 0's
+    watchdog trips while BLOCKED in the collective process 1 never joined:
+    diagnostic header (phase + step), all-thread stack dump, exit 43. The
+    whole job then dies fast — once one process is gone, jax's own
+    coordination client reaps the others with a fatal error — instead of
+    the pre-watchdog outcome: every host wedged in a dead collective until
+    an operator notices.
+
+    Staggered deadlines (8 s on the blocked process, 20 s on the hung one)
+    make the trip order deterministic: the blocked process — the
+    interesting one, proving the watchdog fires DURING a dead collective,
+    not just during a Python-level sleep — always trips first."""
+    results = _run_mh_train(
+        dict(checkpoint_dir=os.path.join(root, "ck"),
+             sample_dir=os.path.join(root, "sm"),
+             collective_timeout_secs=8.0),
+        max_steps=8, chaos={"1": {"hang_at_step": 3, "hang_secs": 300}},
+        extra_per_pid={1: dict(collective_timeout_secs=20.0)},
+        timeout=180)
+    for pid, (rc, out) in enumerate(results):
+        _check(rc != 0, f"process {pid} exited 0 despite the hang")
+        _check("TRAIN_DONE" not in out,
+               f"process {pid} claimed completion: {out[-400:]}")
+    rc0, out0 = results[0]
+    # the Python watchdog thread prints the full diagnostic header and
+    # exits 43; the GIL-immune faulthandler backstop prints "Timeout
+    # (...)!" and exits 1 — either way process 0 dies WITH a stack dump
+    # while blocked, never hangs
+    _check("hung-collective watchdog" in out0 or "Timeout (" in out0,
+           f"blocked process 0 missing watchdog diagnostic: {out0[-800:]}")
+    _check("Thread" in out0 or "Current thread" in out0,
+           f"blocked process 0 missing stack dump: {out0[-800:]}")
+    _check(rc0 in (43, 1),
+           f"process 0 died by something other than the watchdog "
+           f"(rc={rc0}): {out0[-800:]}")
+    if rc0 == 43:
+        _check("step-dispatch" in out0 or "stop-consensus" in out0
+               or "collective-save" in out0,
+               f"watchdog header does not name the blocked phase: "
+               f"{out0[-800:]}")
+    return {"exit_codes": [rc for rc, _ in results],
+            "watchdog_rc": rc0}
+
+
+MH_SCENARIOS = {
+    "mh-nan-rollback": scenario_mh_nan_rollback,
+    "mh-sigterm-stop": scenario_mh_sigterm_stop,
+    "mh-watchdog": scenario_mh_watchdog,
+}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="chaos_drill",
         description="fault-injection scenario matrix for the trainer's "
                     "fail-operational layer (CPU)")
     p.add_argument("--smoke", action="store_true",
-                   help=f"CI subset: {', '.join(SMOKE_SCENARIOS)}")
-    p.add_argument("--only", nargs="+", choices=sorted(SCENARIOS),
+                   help=f"CI subset: {', '.join(SMOKE_SCENARIOS)} "
+                        f"(with --multihost: "
+                        f"{', '.join(MH_SMOKE_SCENARIOS)})")
+    p.add_argument("--multihost", action="store_true",
+                   help="run the 2-process coordinated-recovery matrix "
+                        f"({', '.join(sorted(MH_SCENARIOS))}) instead of "
+                        "the single-process one")
+    p.add_argument("--only", nargs="+",
+                   choices=sorted(SCENARIOS) + sorted(MH_SCENARIOS),
                    default=None, help="run just these scenarios")
     args = p.parse_args(argv)
-    names = (args.only if args.only
-             else SMOKE_SCENARIOS if args.smoke else sorted(SCENARIOS))
+    table = MH_SCENARIOS if args.multihost else SCENARIOS
+    smoke = MH_SMOKE_SCENARIOS if args.multihost else SMOKE_SCENARIOS
+    if args.only:
+        bad = [n for n in args.only if n not in table]
+        if bad:
+            p.error(f"scenario(s) {bad} are not in the "
+                    f"{'multihost' if args.multihost else 'single-process'} "
+                    f"matrix; choose from {sorted(table)}")
+        names = args.only
+    else:
+        names = smoke if args.smoke else sorted(table)
     failures = 0
     for name in names:
         with tempfile.TemporaryDirectory(prefix=f"chaos_{name}_") as root:
             row = {"scenario": name}
             try:
-                row.update(SCENARIOS[name](root))
+                row.update(table[name](root))
                 row["ok"] = True
             except Failure as e:
                 row.update(ok=False, error=str(e))
                 failures += 1
             print(json.dumps(row), flush=True)
-    print(json.dumps({"label": "chaos-drill", "scenarios": len(names),
+    print(json.dumps({"label": "chaos-drill-multihost" if args.multihost
+                      else "chaos-drill", "scenarios": len(names),
                       "failed": failures}), flush=True)
     return 1 if failures else 0
 
