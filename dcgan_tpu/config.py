@@ -436,6 +436,40 @@ class TrainConfig:
                                          # runs (a per-process clock gate would
                                          # deadlock multi-host)
 
+    # Warm start (DESIGN.md §6d): restart goodput — PRs 3-4 made restarts
+    # the normal response to faults, so time-to-first-step is throughput
+    # infrastructure, not a one-off cost
+    compile_cache_dir: str = ""    # non-empty wires JAX's persistent
+                                   # compilation cache at this directory
+                                   # (DCGAN_COMPILE_CACHE_DIR env honored
+                                   # when unset): a restart deserializes
+                                   # every already-seen program instead of
+                                   # recompiling it. Multi-host safe by
+                                   # construction — JAX writes entries from
+                                   # the chief only, every process reads.
+                                   # Cache adoption is surfaced as
+                                   # perf/compile_cache_* counters. "" = off
+                                   # (reference parity)
+    compile_cache_per_process: bool = False  # multi-host without a shared
+                                   # filesystem: give each process its own
+                                   # proc<i>/ subdirectory of
+                                   # compile_cache_dir instead of the
+                                   # chief-writes/all-read shared store
+    aot_warmup: bool = False       # explicit AOT warmup phase before the
+                                   # loop: .lower().compile() every program
+                                   # and every known future call shape (the
+                                   # k=1 n_critic tail, the steps_per_call
+                                   # scan, sampler/probe/summarize, the
+                                   # rollback LR-backoff rebuild variant)
+                                   # with per-program perf/compile_ms
+                                   # timings; with compile_cache_dir set the
+                                   # loop's first dispatches deserialize
+                                   # instead of compiling, and the hung-
+                                   # collective watchdog arms from warmup
+                                   # proof instead of waiting for first
+                                   # live steps. False = compile lazily on
+                                   # first dispatch (reference parity)
+
     # Profiling (SURVEY.md §5 — the reference has none; jax.profiler + step
     # timing is the named TPU-native equivalent)
     profile_dir: str = ""          # non-empty enables trace capture

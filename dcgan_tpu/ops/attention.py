@@ -50,6 +50,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from dcgan_tpu.ops.layers import linear_apply, linear_init
+from dcgan_tpu.utils.backend import shard_map
 
 Pytree = dict
 
@@ -282,12 +283,12 @@ def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
         # heads stay unfolded: the all_to_all itself is the head split.
         # check_vma only without pallas: pallas_call outputs carry no vma
         # annotations (same constraint as ops/norm.py / shard_map_backend)
-        f = jax.shard_map(
+        f = shard_map(
             functools.partial(ulysses_attention, axis_name=seq_axis,
                               n_shards=n, num_heads=num_heads, scale=scale,
                               use_pallas=use_pallas),
             mesh=seq_mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=not use_pallas)
+            check=not use_pallas)
         out = f(q, k, v)
     else:
         if num_heads > 1:
@@ -310,9 +311,9 @@ def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
                 ring_fn = functools.partial(
                     ring_attention, axis_name=seq_axis, n_shards=n,
                     scale=scale)
-            ring = jax.shard_map(
+            ring = shard_map(
                 ring_fn, mesh=seq_mesh, in_specs=(spec, spec, spec),
-                out_specs=spec, check_vma=not use_pallas)
+                out_specs=spec, check=not use_pallas)
             out = ring(q, k, v)
         elif use_pallas:
             from dcgan_tpu.ops.pallas_attention import flash_attention
@@ -330,12 +331,12 @@ def attn_apply(params: Pytree, x: jax.Array, *, compute_dtype=None,
                 # carry no vma annotations (same constraint as
                 # ops/norm.py).
                 spec = P(batch_axis, None, None)
-                out = jax.shard_map(
+                out = shard_map(
                     # scale closed over: custom_vjp nondiff args must stay
                     # positional
                     lambda qs, ks, vs: flash_attention(qs, ks, vs, scale),
                     mesh=pallas_mesh, in_specs=(spec, spec, spec),
-                    out_specs=spec, check_vma=False)(q, k, v)
+                    out_specs=spec, check=False)(q, k, v)
             else:
                 out = flash_attention(q, k, v, scale)
         else:

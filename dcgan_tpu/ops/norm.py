@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dcgan_tpu.utils.backend import shard_map
+
 Pytree = dict
 
 
@@ -72,8 +74,8 @@ def _pallas_shard_moments(x: jax.Array, mesh) -> Tuple[jax.Array, jax.Array]:
     # check_vma=False: pallas_call outputs carry no vma annotations (the
     # same concession the shard_map backend makes, shard_map_backend.py:74);
     # AD still inserts the psum for replicated-input gradients
-    return jax.shard_map(_moments, mesh=mesh, in_specs=(bspec,),
-                         out_specs=(P(), P()), check_vma=False)(x)
+    return shard_map(_moments, mesh=mesh, in_specs=(bspec,),
+                     out_specs=(P(), P()), check=False)(x)
 
 
 def _pallas_shard_epilogue(x, scale, bias, mean, var, *, eps, act, leak,
@@ -90,10 +92,10 @@ def _pallas_shard_epilogue(x, scale, bias, mean, var, *, eps, act, leak,
     def _epilogue(xl, s, b, m, v):
         return fused_bn_act(xl, s, b, m, v, eps=eps, act=act, leak=leak)
 
-    return jax.shard_map(_epilogue, mesh=mesh,
-                         in_specs=(bspec, P(), P(), P(), P()),
-                         out_specs=bspec,
-                         check_vma=False)(x, scale, bias, mean, var)
+    return shard_map(_epilogue, mesh=mesh,
+                     in_specs=(bspec, P(), P(), P(), P()),
+                     out_specs=bspec,
+                     check=False)(x, scale, bias, mean, var)
 
 
 def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,

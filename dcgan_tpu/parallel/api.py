@@ -11,7 +11,7 @@ so parameters update in place in HBM.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Dict, Optional
 
 import jax
 from jax.sharding import Mesh
@@ -52,6 +52,23 @@ class ParallelTrain:
                            # host dispatch instead of K (the host round-trip
                            # the reference paid per step, SURVEY.md §2.4 #10,
                            # amortized K-fold)
+    programs: Dict[str, Callable] = dataclasses.field(default_factory=dict)
+                           # the same jitted surfaces under stable names
+                           # ("init", "train_step", "multi_step", "sampler",
+                           # "summarize", "eval_losses") — the enumeration
+                           # the AOT warmup phase (train/warmup.py) lowers
+                           # and the per-program perf/compile_ms keys are
+                           # reported under; derived from the fields in
+                           # __post_init__ so the two backends cannot
+                           # drift apart
+
+    def __post_init__(self):
+        if not self.programs:
+            object.__setattr__(self, "programs", {
+                "init": self.init, "train_step": self.step,
+                "multi_step": self.multi_step, "sampler": self.sample,
+                "summarize": self.summarize,
+                "eval_losses": self.eval_losses})
 
 
 def make_multi_step_body(step_fn: Callable) -> Callable:

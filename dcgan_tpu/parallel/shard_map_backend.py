@@ -81,8 +81,14 @@ def make_shard_map_train(cfg: TrainConfig,
     vma = not cfg.model.use_pallas
 
     def smap(f, in_specs, out_specs):
-        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=vma)
+        # utils/backend.shard_map: the check_vma/check_rep API-graduation
+        # compat shim every shard_map site shares — without it this whole
+        # backend (and its slow-marked, hence tier-1-invisible, test
+        # suite) failed at first use on this container's jax 0.4.37
+        from dcgan_tpu.utils.backend import shard_map
+
+        return shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check=vma)
 
     rep = replicated(mesh)
     img_spec = P(DATA_AXIS, None, None, None)

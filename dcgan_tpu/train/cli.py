@@ -209,6 +209,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "every-step log; 0 = off)")
     p.add_argument("--activation_summary_steps", type=int, default=500,
                    help="per-layer activation histogram cadence (0 = off)")
+    # warm start (DESIGN.md §6d)
+    p.add_argument("--compile_cache_dir", default="",
+                   help="non-empty wires JAX's persistent compilation "
+                        "cache here (DCGAN_COMPILE_CACHE_DIR env honored "
+                        "when unset): restarts deserialize already-seen "
+                        "programs instead of recompiling; adoption is "
+                        "surfaced as perf/compile_cache_* counters")
+    p.add_argument("--compile_cache_per_process", type=_parse_bool,
+                   default=False, metavar="{true,false}",
+                   help="multi-host without a shared filesystem: each "
+                        "process keeps its own proc<i>/ cache subdirectory "
+                        "instead of the chief-writes/all-read shared store")
+    p.add_argument("--aot_warmup", type=_parse_bool, default=False,
+                   metavar="{true,false}",
+                   help="AOT-compile every program and known future call "
+                        "shape (k=1 tail, steps_per_call scan, sampler/"
+                        "probe, rollback LR-backoff variant) before the "
+                        "loop, with per-program perf/compile_ms timings; "
+                        "pair with --compile_cache_dir so live dispatches "
+                        "deserialize the warmed entries")
     # profiling (SURVEY.md §5 — trace capture the reference never had)
     p.add_argument("--profile_dir", default="",
                    help="capture a jax.profiler trace into this dir")
@@ -296,6 +316,9 @@ _FLAG_FIELDS = {
     "rollback_lr_backoff": ("", "rollback_lr_backoff"),
     "max_corrupt_records": ("", "max_corrupt_records"),
     "activation_summary_steps": ("", "activation_summary_steps"),
+    "compile_cache_dir": ("", "compile_cache_dir"),
+    "compile_cache_per_process": ("", "compile_cache_per_process"),
+    "aot_warmup": ("", "aot_warmup"),
     "profile_dir": ("", "profile_dir"),
     "profile_start_step": ("", "profile_start_step"),
     "profile_num_steps": ("", "profile_num_steps"),

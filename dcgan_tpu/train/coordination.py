@@ -79,6 +79,24 @@ def anomaly_consensus(local_bad: bool) -> Tuple[bool, List[int]]:
     return bool(gathered.any()), [int(i) for i in np.nonzero(gathered)[0]]
 
 
+def warmup_barrier(tag: str = "aot-warmup") -> None:
+    """Block until EVERY process has finished its AOT warmup phase.
+
+    The warm proof the watchdog's arming gate needs (ISSUE 5): compile time
+    is per-process, so one host finishing ITS warmup says nothing about its
+    peers — but every host returning from this barrier proves no peer can
+    still be inside a first compile, which is exactly the startup-skew
+    hazard `mesh_warm` exists to wait out. Free in single-process runs; the
+    trainer only calls it when `--aot_warmup` is on, so default dispatch
+    streams gain no collective.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
 class CoordinatedStop:
     """Signal-flag consensus for a resumable whole-job stop.
 

@@ -64,13 +64,13 @@ from dcgan_tpu.parallel import (
     make_parallel_train,
 )
 from dcgan_tpu.testing import chaos
-from dcgan_tpu.train import coordination
+from dcgan_tpu.train import coordination, warmup
 from dcgan_tpu.train.rollback import RollbackManager
 from dcgan_tpu.train.services import make_services
 from dcgan_tpu.utils.checkpoint import Checkpointer
 from dcgan_tpu.utils.images import save_sample_grid
 from dcgan_tpu.utils.metrics import MetricWriter, param_histograms
-from dcgan_tpu.utils.profiling import StepTimer, TraceCapture
+from dcgan_tpu.utils.profiling import StartupProfile, StepTimer, TraceCapture
 
 Pytree = Any
 
@@ -282,14 +282,45 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
            max_steps: Optional[int],
            stop: coordination.CoordinatedStop) -> Pytree:
     initialize_multihost()
+    # Warm start (DESIGN.md §6d): the persistent compile cache must be
+    # configured before the FIRST compile of this run (pt.init in the run
+    # body), and after the multi-host bring-up (per-process keying reads
+    # the real process index). Startup phases are profiled from here —
+    # restarts are this trainer's normal response to faults (PRs 3-4), so
+    # time-to-first-step is tracked like throughput.
+    startup = StartupProfile()
+    cache_dir = warmup.configure_compile_cache(
+        warmup.resolve_cache_dir(cfg.compile_cache_dir),
+        per_process=cfg.compile_cache_per_process)
+    cache_mon = warmup.CompileCacheMonitor() if cache_dir is not None \
+        else None
+    try:
+        return _train_run(cfg, synthetic_data=synthetic_data,
+                          max_steps=max_steps, stop=stop, startup=startup,
+                          cache_dir=cache_dir, cache_mon=cache_mon)
+    finally:
+        if cache_mon is not None:
+            # unregister the monitoring listeners on EVERY exit — config
+            # validation errors and failed warmups included; a process
+            # that calls train() again (tests, drills) must not accumulate
+            # double-counting listeners
+            cache_mon.close()
+
+
+def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
+               max_steps: Optional[int],
+               stop: coordination.CoordinatedStop, startup: StartupProfile,
+               cache_dir: Optional[str],
+               cache_mon) -> Pytree:
     if cfg.fid_every_steps and jax.process_count() > 1 \
             and cfg.fid_num_samples % jax.process_count():
         raise ValueError(
             f"fid_num_samples ({cfg.fid_num_samples}) must divide evenly "
             f"over {jax.process_count()} processes — the in-training probe "
             "splits the sample budget per process (VERDICT r2 #5)")
-    mesh = make_mesh(cfg.mesh)
-    pt = make_parallel_train(cfg, mesh)
+    with startup.phase("init"):
+        mesh = make_mesh(cfg.mesh)
+        pt = make_parallel_train(cfg, mesh)
     chief = is_chief()
     # the quarantine tally is process-global (it spans both loader
     # implementations and the train+sample pipelines); this run reports its
@@ -328,8 +359,10 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
                           enabled=chief,
                           tensorboard=cfg.tensorboard)
 
-    state = pt.init(jax.random.key(cfg.seed))
-    restored = ckpt.restore_latest(state)
+    with startup.phase("init"):
+        state = pt.init(jax.random.key(cfg.seed))
+    with startup.phase("restore"):
+        restored = ckpt.restore_latest(state)
     if restored is not None:
         state = restored
         if chief:
@@ -368,14 +401,17 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
         sample_labels = jax.numpy.arange(sample_z.shape[0]) \
             % cfg.model.num_classes
 
-    data = _data_iterator(cfg, mesh, synthetic=synthetic_data)
-    # The global-mesh held-out stream feeds the sample-loss probe and, in
-    # single-process runs, the FID probe's real side; the multihost FID
-    # probe streams its own local-mesh iterator instead, so don't spin a
-    # producerless loader for it.
-    sample_data = _sample_data_iterator(cfg, mesh, synthetic=synthetic_data) \
-        if cfg.sample_every_steps or (cfg.fid_every_steps
-                                      and jax.process_count() == 1) else None
+    with startup.phase("data"):
+        data = _data_iterator(cfg, mesh, synthetic=synthetic_data)
+        # The global-mesh held-out stream feeds the sample-loss probe and,
+        # in single-process runs, the FID probe's real side; the multihost
+        # FID probe streams its own local-mesh iterator instead, so don't
+        # spin a producerless loader for it.
+        sample_data = _sample_data_iterator(
+            cfg, mesh, synthetic=synthetic_data) \
+            if cfg.sample_every_steps or (cfg.fid_every_steps
+                                          and jax.process_count() == 1) \
+            else None
     # fixed z for the loss probe, tiled to the probe batch size (the
     # reference feeds the same sample_z every time, image_train.py:77,181)
     eval_z = jax.numpy.resize(sample_z, (cfg.batch_size, cfg.model.z_dim)) \
@@ -458,6 +494,60 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
             fid_best = float(multihost_utils.broadcast_one_to_all(
                 np.asarray(fid_best, np.float64)))
 
+    # AOT warmup (DESIGN.md §6d): compile every program and every known
+    # future call shape up front — the k=1 n_critic tail, the
+    # steps_per_call scan, the sampler/probe/summarize shapes, and (when
+    # the cache can make it stick) the rollback LR-backoff rebuild variant
+    # as a fully-built pre-warmed ParallelTrain. With the persistent cache
+    # active the loop's first dispatches deserialize the warmed entries, so
+    # `warm_proof` below can seed the watchdog's mesh-warm gate and
+    # `compiled_ks` exemption set from warmup proof instead of waiting for
+    # first live steps.
+    # "fleet-warm": every process's live dispatches will HIT the primed
+    # cache — true single-process and in the shared-dir multi-host mode,
+    # false for per-process dirs under multi-host (jaxlib writes entries
+    # chief-only, so non-chief proc<i>/ stores never fill and their live
+    # dispatches still compile). Everything that assumes warm hits —
+    # watchdog warm proof, the compiled_ks seed, the pre-warmed backoff
+    # swap that deliberately skips the recompile exemption — rides on this
+    # one predicate, never on the cache dir merely being set.
+    cache_fleet_wide = cache_dir is not None and \
+        warmup.cache_serves_all_processes(cfg.compile_cache_per_process)
+    pt_backoff = None   # pre-warmed LR-backoff surface for the 1st rollback
+    warm_ms: dict = {}
+    if cfg.aot_warmup:
+        if chief and cache_dir is None:
+            print("[dcgan_tpu] --aot_warmup without --compile_cache_dir: "
+                  "warmed programs are recompiled at first live dispatch "
+                  "(compile timings still recorded); set a cache dir so "
+                  "dispatches deserialize the warmed entries", flush=True)
+        if chief and cache_dir is not None and not cache_fleet_wide:
+            print("[dcgan_tpu] --compile_cache_per_process under "
+                  "multi-host: this jaxlib writes cache entries from the "
+                  "chief only, so non-chief proc<i>/ stores stay empty — "
+                  "warm restarts still recompile there, and warmup is NOT "
+                  "used as watchdog warm proof (use one shared "
+                  "--compile_cache_dir to warm the whole fleet)",
+                  flush=True)
+        with startup.phase("warmup"):
+            plan, pt_backoff = warmup.build_warmup_plan(
+                cfg, pt, state,
+                sample_z=sample_z if cfg.sample_every_steps else None,
+                sample_labels=sample_labels, eval_z=eval_z,
+                make_backoff_pt=(lambda c: make_parallel_train(c, mesh))
+                if cache_fleet_wide else None)
+            warm_ms = warmup.aot_compile(plan)
+            # every peer past its compiles before anyone proceeds: the warm
+            # proof the watchdog gate needs, and the point where startup
+            # skew is paid once instead of surfacing inside guarded windows
+            coordination.warmup_barrier()
+        if chief:
+            print("[dcgan_tpu] aot warmup compiled "
+                  + f"{len(warm_ms)} program(s): "
+                  + ", ".join(f"{k} {v:.0f}ms"
+                              for k, v in warm_ms.items()), flush=True)
+    warm_proof = cfg.aot_warmup and cache_fleet_wide
+
     total_steps = max_steps if max_steps is not None else cfg.max_steps
     start_step = int(jax.device_get(state["step"]))
     t_start = time.time()
@@ -499,11 +589,13 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
     # guarded collective can legitimately block for however long the
     # SLOWEST peer's compile takes (startup skew), and a deadline there
     # would kill a healthy job. "Warm" = proof that every peer is past its
-    # first compile: the first metric readback completing (_host_vals) or
-    # a boundary-N>0 stop poll returning (each device stream runs that
-    # allgather only after its step program). Single-process has no peer
-    # skew to wait out.
-    mesh_warm = n_proc == 1
+    # first compile: the first metric readback completing (_host_vals), a
+    # boundary-N>0 stop poll returning (each device stream runs that
+    # allgather only after its step program), or — ISSUE 5 — warmup proof:
+    # every peer returned from the AOT warmup barrier with the persistent
+    # cache primed, so live dispatches deserialize (bounded IO) instead of
+    # compiling. Single-process has no peer skew to wait out.
+    mesh_warm = n_proc == 1 or warm_proof
 
     def _guard(phase: str, step: int):
         """A watchdog guard that is a free no-op until the mesh is warm."""
@@ -536,7 +628,13 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
             snap = device_copy(params)
             _stage(snap)
             return snap
-        return jax.device_get(params)
+        # owned_host_copy, not bare device_get: the histogram must capture
+        # THIS step's params, not whatever the next donated dispatch
+        # leaves in the buffer a cache-deserialized executable overwrote
+        # in place (utils/checkpoint.owned_host_copy owns the workaround)
+        from dcgan_tpu.utils.checkpoint import owned_host_copy
+
+        return owned_host_copy(params)
 
     def _host_vals(p: dict) -> dict:
         """Materialized {name: float} metric scalars for one step's record,
@@ -552,7 +650,46 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
             # a completed cross-process readback is the warm proof the
             # watchdog gating waits for (see mesh_warm above)
             mesh_warm = True
+            if not startup.done:
+                # first proven device-progress point = time-to-first-step
+                startup.first_step()
+                _report_startup(p["step"])
         return p["host"]
+
+    def _report_startup(step: int) -> None:
+        """One startup-breakdown report per run, at the first completed
+        step: phase ms + compile-cache counters + per-program warmup
+        compile ms + restore stats. Always printed (stdout is free);
+        written as JSONL perf/ keys ONLY when a warm-start knob is active —
+        default-flags event streams stay byte-identical (parity contract).
+        """
+        row = startup.summary()
+        if cache_mon is not None:
+            c = cache_mon.counters()
+            row.update({
+                "perf/compile_cache_requests": c["requests"],
+                "perf/compile_cache_hits": c["hits"],
+                "perf/compile_cache_misses": c["misses"],
+            })
+        for name, ms in warm_ms.items():
+            row[f"perf/compile_ms/{name}"] = ms
+        rs = ckpt.last_restore_stats
+        if rs is not None:
+            row.update({
+                "perf/restore/verify_files": rs["files"],
+                "perf/restore/verify_bytes": rs["bytes_read"],
+                "perf/restore/verify_cached_bytes": rs["bytes_cached"],
+                "perf/restore/verify_ms": rs["verify_ms"],
+            })
+        if chief:
+            import json as _json
+
+            print("[dcgan_tpu] startup "
+                  + _json.dumps({k: round(v, 1) for k, v in row.items()}),
+                  flush=True)
+            if cache_dir is not None or cfg.aot_warmup:
+                svc.submit(lambda s=step, r=dict(row):
+                           writer.write_scalars(s, r), tag="startup")
 
     def _health_extras() -> dict:
         """Recovery counters riding the scalar rows — absent until nonzero,
@@ -635,7 +772,7 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
         bitwise re-running into the same divergence. The data iterator is
         NOT rewound: the offending batch window is skipped by construction.
         """
-        nonlocal state, step_num, pending, pt, base_key
+        nonlocal state, step_num, pending, pt, base_key, pt_backoff
         fail_step = getattr(e, "step", step_num)
         # recovery's COLLECTIVE half stays under the watchdog: the
         # device-resident restore dispatches and delete_steps_after's
@@ -665,21 +802,29 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
         watchdog.disarm()  # collectives done; the rebuild below compiles
         if rollback.lr_backoff < 1.0:
             scale = rollback.lr_scale()
-
-            def _bk(lr):
-                return None if lr is None else lr * scale
-
-            pt = make_parallel_train(
-                dataclasses.replace(
-                    cfg, learning_rate=cfg.learning_rate * scale,
-                    d_learning_rate=_bk(cfg.d_learning_rate),
-                    g_learning_rate=_bk(cfg.g_learning_rate)), mesh)
-            # the rebuilt step programs compile on their next dispatch —
-            # exempt those windows from the watchdog like the first ones
-            compiled_ks.clear()
-            if chief:
-                print(f"[dcgan_tpu] rollback LR backoff: base rates "
-                      f"scaled by {scale:.3g}", flush=True)
+            if pt_backoff is not None and rollback.rollbacks == 1:
+                # the AOT warmup phase pre-built and cache-primed exactly
+                # this variant (warmup.backoff_config — one shared
+                # construction, so the HLO and cache key are bit-identical):
+                # the swapped-in surface deserializes at its next dispatch
+                # instead of recompiling mid-recovery, and compiled_ks
+                # stays intact — no recompile event, no exemption needed
+                pt = pt_backoff
+                pt_backoff = None  # scale^2 at a 2nd rollback: rebuild then
+                if chief:
+                    print(f"[dcgan_tpu] rollback LR backoff: base rates "
+                          f"scaled by {scale:.3g} (pre-warmed surface "
+                          f"swapped in — no recompile)", flush=True)
+            else:
+                pt = make_parallel_train(
+                    warmup.backoff_config(cfg, scale), mesh)
+                # the rebuilt step programs compile on their next dispatch
+                # — exempt those windows from the watchdog like the first
+                # ones
+                compiled_ks.clear()
+                if chief:
+                    print(f"[dcgan_tpu] rollback LR backoff: base rates "
+                          f"scaled by {scale:.3g}", flush=True)
         base_key = jax.random.fold_in(jax.random.key(cfg.seed + 2),
                                       rollback.rollbacks)
 
@@ -704,8 +849,15 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
     step_num = start_step
     # call shapes (steps_per_call k values) already dispatched against the
     # CURRENT `pt` — the watchdog only arms dispatch windows for these;
-    # cleared when a rollback LR backoff rebuilds the compiled step
+    # cleared when a rollback LR backoff rebuilds the compiled step.
+    # Warmup proof seeds the set (both the k=1 tail and the scan shape were
+    # AOT-compiled into the persistent cache), so guarded dispatch starts
+    # at the FIRST boundary instead of after one live pass per shape.
     compiled_ks: set = set()
+    if warm_proof:
+        compiled_ks.add(1)
+        if cfg.steps_per_call > 1:
+            compiled_ks.add(cfg.steps_per_call)
     if rollback is not None:
         # arm the initial restore point: a fresh init or a checkpoint
         # restore — both trusted (the checkpoint passed integrity

@@ -1,0 +1,321 @@
+"""Warm-start subsystem: persistent compile cache + AOT pre-compilation.
+
+PRs 3-4 made restarts the NORMAL response to faults (watchdog exit 43,
+coordinated preemption stop, rollback recompiles), which moves the dominant
+cost of a preemptible fleet from steady-state step time to startup: every
+restart re-pays full XLA compilation of every program plus the checkpoint
+read. The pjit/TPUv4 scaling work (PAPERS.md, arxiv 2204.06514) treats
+compilation caching as first-class throughput infrastructure and ParaGAN
+(arxiv 2411.03999) frames GAN efficiency as end-to-end goodput; this module
+is that discipline for tpu-dcgan's time-to-first-step:
+
+- `configure_compile_cache` wires JAX's persistent compilation cache behind
+  `--compile_cache_dir` (config + CLI + `DCGAN_COMPILE_CACHE_DIR` env). The
+  multi-host keying is safe by construction: JAX's cache layer only WRITES
+  entries from process 0 (chief-writes) while every process reads, so one
+  shared directory never sees write contention; for fleets without a shared
+  filesystem, `--compile_cache_per_process` gives each process its own
+  subdirectory instead (`proc<i>/` — same cache keys, disjoint stores).
+  The min-compile-time threshold is dropped to 0: this trainer runs a
+  handful of long-lived programs, every one of which is re-lowered on every
+  restart, so "too cheap to cache" (JAX's default 1 s floor, tuned for
+  jit-churn workloads) is the wrong default here.
+
+- `CompileCacheMonitor` subscribes to JAX's monitoring events and turns
+  them into the `perf/compile_cache_{requests,hits,misses}` counters the
+  trainer surfaces as JSONL events — cache effectiveness is a recorded
+  number per run, not a log grep.
+
+- `build_warmup_plan` + `aot_compile` are the explicit AOT warmup phase
+  (`--aot_warmup`): every program the run can dispatch — the k=1 n_critic
+  tail, the `steps_per_call` scan variant, the sampler/probe/summarize
+  shapes, and the LR-backoff rebuild variant (`backoff_config`, shared with
+  the trainer's rollback executor so the two constructions cannot drift) —
+  is `.lower().compile()`d up front with per-program `perf/compile_ms/*`
+  timings. With the persistent cache active, each warmup compile primes the
+  cache entry the loop's live dispatch then deserializes, so first-dispatch
+  cost is bounded IO, not compile — which is what lets the trainer's
+  watchdog arm from warmup PROOF (mesh_warm + the `compiled_ks` exemption
+  set) instead of waiting for first live steps.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+CACHE_ENV_VAR = "DCGAN_COMPILE_CACHE_DIR"
+
+#: monitoring event name -> counter key (the three adoption counters JAX's
+#: compile path records around the persistent cache)
+_EVENT_COUNTERS = {
+    "/jax/compilation_cache/compile_requests_use_cache": "requests",
+    "/jax/compilation_cache/cache_hits": "hits",
+    "/jax/compilation_cache/cache_misses": "misses",
+}
+_SAVED_EVENT = "/jax/compilation_cache/compile_time_saved_sec"
+
+
+def resolve_cache_dir(cfg_dir: str, env=None) -> str:
+    """The effective cache dir: the config/CLI value, else the
+    DCGAN_COMPILE_CACHE_DIR environment override, else "" (off)."""
+    env = os.environ if env is None else env
+    return cfg_dir or env.get(CACHE_ENV_VAR, "")
+
+
+def configure_compile_cache(cache_dir: str, *,
+                            per_process: bool = False) -> Optional[str]:
+    """Point JAX's persistent compilation cache at `cache_dir`; returns the
+    effective directory (per-process subdir under `per_process`) or None
+    when caching stays off. Must run before the first compile — the trainer
+    calls it right after `initialize_multihost()` (the per-process keying
+    needs the real process index), before any program is built.
+    """
+    if not cache_dir:
+        # explicit OFF: a previous train() in this process may have pointed
+        # the GLOBAL jax cache somewhere — leaving it set would keep
+        # deserializing executables in a run whose donation-safety guards
+        # (trainer/rollback/checkpoint, keyed on the cache being active)
+        # believe the cache is off
+        if getattr(jax.config, "jax_compilation_cache_dir", None):
+            jax.config.update("jax_compilation_cache_dir", None)
+            _reset_cache_object()
+        return None
+    if per_process and jax.process_count() > 1:
+        # no shared filesystem: disjoint per-process stores. Keys are
+        # process-independent, so this trades dedup for zero cross-host
+        # filesystem assumptions. jaxlib <= 0.4.37 only WRITES cache
+        # entries from process 0, so non-chief stores stay empty (reads
+        # are harmless) — the trainer excludes this mode from watchdog
+        # warm proof and warns, rather than arming deadlines over peers
+        # that will in fact recompile.
+        cache_dir = os.path.join(cache_dir, f"proc{jax.process_index()}")
+    changed = getattr(jax.config, "jax_compilation_cache_dir",
+                      None) != cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # cache EVERY program: this trainer compiles a handful of long-lived
+    # programs per run, all re-lowered on every restart — the "skip cheap
+    # compiles" defaults exist for jit-churn workloads, not this shape
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    if changed:
+        # jax memoizes the cache OBJECT on first use; without this a
+        # process that re-points the dir keeps reading/writing the old one
+        _reset_cache_object()
+    return cache_dir
+
+
+def _reset_cache_object() -> None:
+    """Drop jax's memoized persistent-cache object so the current
+    `jax_compilation_cache_dir` value takes effect (jax initializes the
+    object lazily ONCE and never re-reads the config)."""
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:
+        pass  # future jax: internal module moved; first-use init wins
+
+
+def cache_serves_all_processes(per_process: bool) -> bool:
+    """Whether a warm restart can expect cache HITS on every process —
+    the condition watchdog warm proof rides on. True for single-process
+    and for the shared-dir multi-host mode (the chief writes during its
+    AOT compiles, the warmup barrier orders those writes before any peer's
+    live dispatch reads them). False for per-process dirs under multi-host
+    on jaxlib <= 0.4.37: only process 0's store is ever written, so every
+    other process recompiles at first live dispatch no matter how warm its
+    warmup looked."""
+    return jax.process_count() == 1 or not per_process
+
+
+class CompileCacheMonitor:
+    """Counts persistent-cache adoption through jax.monitoring.
+
+    The counters are process-local and monotonic from construction;
+    `counters()` snapshots them, `delta(since)` diffs two snapshots (the
+    trainer brackets phases with it). `close()` unregisters the listeners —
+    required in multi-`train()` processes (tests, drills) or each monitor
+    would keep double-counting forever.
+    """
+
+    def __init__(self) -> None:
+        from jax._src import monitoring
+
+        self._monitoring = monitoring
+        self._counts: Dict[str, int] = {k: 0 for k in
+                                        _EVENT_COUNTERS.values()}
+        self._saved_secs = 0.0
+        self._closed = False
+
+        def _on_event(event: str, **kw) -> None:
+            key = _EVENT_COUNTERS.get(event)
+            if key is not None:
+                self._counts[key] += 1
+
+        def _on_duration(event: str, duration_secs: float, **kw) -> None:
+            if event == _SAVED_EVENT:
+                self._saved_secs += duration_secs
+
+        self._on_event = _on_event
+        self._on_duration = _on_duration
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+
+    def counters(self) -> Dict[str, float]:
+        out: Dict[str, float] = dict(self._counts)
+        out["saved_ms"] = self._saved_secs * 1e3
+        return out
+
+    @staticmethod
+    def delta(now: Dict[str, float],
+              since: Dict[str, float]) -> Dict[str, float]:
+        return {k: now[k] - since.get(k, 0) for k in now}
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for unreg, cb in (
+                (self._monitoring._unregister_event_listener_by_callback,
+                 self._on_event),
+                (self._monitoring
+                 ._unregister_event_duration_listener_by_callback,
+                 self._on_duration)):
+            try:
+                unreg(cb)
+            except Exception:
+                pass  # listener registry changed under us — nothing to leak
+
+
+def backoff_config(cfg, scale: float):
+    """The rollback LR-backoff TrainConfig variant — ONE construction shared
+    by the trainer's rollback executor and the warmup plan, so the program
+    the warmup pre-compiles is bit-identical (same HLO constants, same cache
+    key) to the one a live rollback rebuilds."""
+    import dataclasses
+
+    def _bk(lr):
+        return None if lr is None else lr * scale
+
+    return dataclasses.replace(
+        cfg, learning_rate=cfg.learning_rate * scale,
+        d_learning_rate=_bk(cfg.d_learning_rate),
+        g_learning_rate=_bk(cfg.g_learning_rate))
+
+
+def _identity_copy():
+    """A jit of the SAME identity lambda rollback.device_copy and the
+    checkpoint rebase compile — byte-identical HLO, so one persistent-cache
+    entry serves all three jit objects. Deliberately a FRESH jit per call:
+    a memoized object would serve repeat warmups from its in-memory AOT
+    cache and skip the persistent-cache write a newly-pointed cache dir
+    needs (multi-`train()` processes — tests, drills)."""
+    return jax.jit(lambda t: jax.tree_util.tree_map(lambda a: a + 0, t))
+
+
+def _program_args(cfg, pt, state, *, sample_z=None, sample_labels=None,
+                  eval_z=None) -> List[Tuple[str, Callable, tuple]]:
+    """(name, jitted fn, example args) for every program `pt` can dispatch
+    this run, with the trainer's exact live shapes/shardings: images as
+    sharded ShapeDtypeStructs (never allocated), z/labels/state as the
+    concrete arrays the loop itself feeds."""
+    import jax.numpy as jnp
+
+    from dcgan_tpu.parallel import batch_sharding
+
+    mesh = pt.mesh
+    size = cfg.model.output_size
+    img_sh = batch_sharding(mesh, 4, spatial=cfg.mesh.spatial)
+    img = jax.ShapeDtypeStruct(
+        (cfg.batch_size, size, size, cfg.model.c_dim), jnp.float32,
+        sharding=img_sh)
+    conditional = cfg.model.num_classes > 0
+    key = jax.random.key(0)
+    lbls = (jax.ShapeDtypeStruct((cfg.batch_size,), jnp.int32,
+                                 sharding=batch_sharding(mesh, 1)),) \
+        if conditional else ()
+
+    def _scan_sds(sds, k):
+        return jax.ShapeDtypeStruct(
+            (k,) + sds.shape, sds.dtype,
+            sharding=jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(None, *sds.sharding.spec)))
+
+    programs: List[Tuple[str, Callable, tuple]] = [
+        ("train_step", pt.programs["train_step"],
+         (state, img, key) + lbls),
+        # the state-tree identity copy: the program behind BOTH the
+        # checkpoint restore's buffer rebase (utils/checkpoint.py) and the
+        # rollback device-resident snapshot (train/rollback.device_copy) —
+        # same lambda, same HLO, one cache entry serves all three jit
+        # objects, so a warm restart's restore-time rebase deserializes
+        # instead of being the one cold compile left on the restart path
+        ("state_copy", _identity_copy(), (state,)),
+    ]
+    k = cfg.steps_per_call
+    if k > 1:
+        scan_img = _scan_sds(img, k)
+        keys = jax.vmap(jax.random.fold_in, (None, 0))(key, jnp.arange(k))
+        scan_lbls = (_scan_sds(lbls[0], k),) if conditional else ()
+        programs.append((f"multi_step@k{k}", pt.programs["multi_step"],
+                         (state, scan_img, keys) + scan_lbls))
+    if sample_z is not None:
+        s_lbls = (sample_labels,) if sample_labels is not None else ()
+        programs.append(("sampler", pt.programs["sampler"],
+                         (state, sample_z) + s_lbls))
+    if eval_z is not None:
+        programs.append(("eval_losses", pt.programs["eval_losses"],
+                         (state, img, eval_z) + lbls))
+    if cfg.activation_summary_steps:
+        programs.append(("summarize", pt.programs["summarize"],
+                         (state, img, key) + lbls))
+    return programs
+
+
+def build_warmup_plan(cfg, pt, state, *, sample_z=None, sample_labels=None,
+                      eval_z=None, make_backoff_pt: Optional[Callable] = None
+                      ) -> Tuple[List[Tuple[str, Callable, tuple]],
+                                 Optional[Any]]:
+    """Every (name, program, args) this run can dispatch, plus — when the
+    run arms `rollback_lr_backoff` — a fully-built ParallelTrain for the
+    FIRST rollback's LR scale whose step programs join the plan, so a live
+    rollback swaps in a pre-warmed surface instead of recompiling mid-
+    recovery. `make_backoff_pt` maps the backoff TrainConfig to that
+    surface (the trainer passes make_parallel_train pinned to its mesh)."""
+    plan = _program_args(cfg, pt, state, sample_z=sample_z,
+                         sample_labels=sample_labels, eval_z=eval_z)
+    pt_backoff = None
+    if (cfg.nan_policy == "rollback" and cfg.rollback_lr_backoff < 1.0
+            and make_backoff_pt is not None):
+        pt_backoff = make_backoff_pt(
+            backoff_config(cfg, cfg.rollback_lr_backoff))
+        for name, fn, args in _program_args(
+                cfg, pt_backoff, state, sample_z=sample_z,
+                sample_labels=sample_labels, eval_z=eval_z):
+            # only the step programs rebuild on rollback; sampler/probe/
+            # summarize are LR-independent (identical HLO, already planned)
+            if name.startswith(("train_step", "multi_step")):
+                plan.append((f"{name}@lr_backoff", fn, args))
+    return plan, pt_backoff
+
+
+def aot_compile(plan: List[Tuple[str, Callable, tuple]],
+                ) -> Dict[str, float]:
+    """`.lower().compile()` every planned program; {name: compile_ms}.
+
+    Each compile lands in the persistent cache (when configured), so the
+    loop's live dispatch of the same program deserializes instead of
+    compiling — warmup converts unbounded compile time into bounded IO at
+    a point where nothing is blocked on it.
+    """
+    timings: Dict[str, float] = {}
+    for name, fn, args in plan:
+        t0 = time.perf_counter()
+        fn.lower(*args).compile()
+        timings[name] = (time.perf_counter() - t0) * 1e3
+    return timings

@@ -24,6 +24,24 @@ import sys
 import time
 
 
+def shard_map(f, *, mesh, in_specs, out_specs, check: bool = True):
+    """`jax.shard_map` across the API graduation: the modern form takes
+    `check_vma`, the `jax.experimental.shard_map` form this container's
+    jaxlib ships takes `check_rep` (same meaning). Every shard_map call
+    site in the codebase routes through here — without the fallback the
+    whole explicit-collective layer (shard_map backend, ring attention,
+    per-shard Pallas BN) failed at first use on jax 0.4.37."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check)
+
+
 def _reset_backend_state() -> None:
     """Clear JAX's cached (possibly poisoned) backend state."""
     try:

@@ -21,21 +21,63 @@ falls back to the next-newest intact checkpoint. Steps without a manifest
 (legacy dirs, or a crash before the manifest landed) are trusted as before —
 verification only ever ADDS protection. Manifest IO runs under
 utils/retry.retry_io, so one transient host-IO error does not fail a save.
+
+Single-pass verified restore (ISSUE 5): the seed's restore read every
+checkpoint byte TWICE — a sequential checksum pass over the whole step,
+then Orbax's leaf payload read of the same files. Restarts are this
+trainer's normal fault response (PRs 3-4), so that double full read sat on
+the critical path of every recovery. Now `restore_latest` fuses the two:
+a size pre-check from stat metadata (zero payload bytes; catches
+truncation, the dominant real-world corruption, before anything is
+dispatched), a pre-parse checksum of the SMALL structural files (so the
+native parser never consumes unverified metadata), then the checksum pass
+over the bulk array chunks runs THREAD-POOLED in manifest (tree) order on
+background threads while the calling thread runs the Orbax leaf
+payload read right behind it — the verifier streams each file into the
+page cache and the payload read is served from memory, so the step's bytes
+come off storage once and restore wall-clock is max(verify, restore)
+instead of their sum. The verification CONTRACT is unchanged: the restore
+result is returned only after a clean checksum verdict; a failing verdict
+discards it, quarantines the step, and falls back (a restore exception on
+a step whose checksums FAIL is corruption evidence; on a step whose
+checksums pass it propagates as before). A per-process fingerprint cache
+(path, size, mtime_ns -> crc32) shares save-time manifest hashes with
+restore-time verification, so a file the process itself just checksummed
+is never read again.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 import zlib
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
 Pytree = Any
 
 INTEGRITY_DIRNAME = "integrity"
+
+# fingerprint -> crc32 cache shared by the manifest writer and the restore
+# verifier: (abspath, size, mtime_ns) identifies a file's bytes for the
+# atomic-rename files Orbax and the manifest writer produce, so a file this
+# process already checksummed (at save time, or an earlier verify) is not
+# read again. Process-local, bounded; a changed file changes its
+# fingerprint, so stale entries can never match.
+_CRC_CACHE: Dict[Tuple[str, int, int], int] = {}
+_CRC_CACHE_MAX = 8192
+
+# Files at or under this size are CRC-verified BEFORE the Orbax restore is
+# dispatched; only larger files fuse their verification with the payload
+# read. The small files are the format's structural metadata (OCDBT
+# manifests, _METADATA, sharding records) — feeding corrupt structure to
+# the native parser concurrently would trade the old verify-first ordering
+# for wall-clock on bytes that are cheap to verify anyway; the array chunk
+# files that dominate restore IO stay fused.
+_PREPARSE_VERIFY_MAX_BYTES = 1 << 20
 
 
 def _file_checksum(path: str, chunk: int = 1 << 20) -> Tuple[int, int]:
@@ -52,17 +94,95 @@ def _file_checksum(path: str, chunk: int = 1 << 20) -> Tuple[int, int]:
     return size, crc & 0xFFFFFFFF
 
 
+def _file_checksum_cached(path: str) -> Tuple[int, int, bool]:
+    """(size, crc32, served_from_cache) — one disk read per file per
+    fingerprint per process."""
+    apath = os.path.abspath(path)
+    st = os.stat(apath)
+    key = (apath, st.st_size, st.st_mtime_ns)
+    crc = _CRC_CACHE.get(key)
+    if crc is not None:
+        return st.st_size, crc, True
+    size, crc = _file_checksum(apath)
+    if len(_CRC_CACHE) >= _CRC_CACHE_MAX:
+        _CRC_CACHE.clear()
+    # fingerprint with the POST-read stat only if unchanged mid-read
+    st2 = os.stat(apath)
+    if (st2.st_size, st2.st_mtime_ns) == (st.st_size, st.st_mtime_ns):
+        _CRC_CACHE[key] = crc
+    return size, crc, False
+
+
 def _dir_checksums(step_dir: str) -> Dict[str, Dict[str, int]]:
     """{relative path: {size, crc32}} over every regular file under
-    `step_dir`."""
+    `step_dir` (hashes land in the fingerprint cache, so a same-process
+    restore verifies them without re-reading)."""
     out: Dict[str, Dict[str, int]] = {}
     for root, _, files in os.walk(step_dir):
         for name in sorted(files):
             path = os.path.join(root, name)
             rel = os.path.relpath(path, step_dir)
-            size, crc = _file_checksum(path)
+            size, crc, _ = _file_checksum_cached(path)
             out[rel] = {"size": size, "crc32": crc}
     return out
+
+
+_IDENTITY_COPY = None
+
+
+def _rebase_onto_xla_buffers(tree: Pytree) -> Pytree:
+    """Rebase a just-restored tree onto fresh XLA-owned buffers via one
+    non-donating jitted identity pass (the rollback.device_copy idiom).
+
+    Workaround for a jaxlib 0.4.37 CPU interaction the warm-start work
+    surfaced: DONATING a tensorstore-backed buffer (what Orbax restore
+    returns) into an executable DESERIALIZED from the persistent
+    compilation cache corrupts the heap (malloc_consolidate/SIGSEGV a few
+    dispatches later). Reading such buffers is fine — only donation is
+    broken — so one identity copy whose outputs are ordinary XLA
+    allocations makes the restored state safe to feed the trainer's
+    donated step programs. Applied only when the persistent cache is
+    configured (the only regime that deserializes executables); costs one
+    device-side copy of the state, value- and sharding-preserving, and is
+    a mesh-consistent per-shard program under multi-host (every process
+    dispatches it at the same point, like the rollback snapshot copy)."""
+    global _IDENTITY_COPY
+    if _IDENTITY_COPY is None:
+        _IDENTITY_COPY = jax.jit(
+            lambda t: jax.tree_util.tree_map(lambda a: a + 0, t))
+    return _IDENTITY_COPY(tree)
+
+
+def persistent_cache_active() -> bool:
+    """Whether JAX's persistent compilation cache is configured — the only
+    regime that runs DESERIALIZED executables, where donated non-XLA-owned
+    buffers are unsafe (see _rebase_onto_xla_buffers; train/rollback.py
+    applies the same rebase to its host-snapshot restore path)."""
+    try:
+        return bool(jax.config.jax_compilation_cache_dir)
+    except AttributeError:  # future jax: config knob renamed/removed
+        return False
+
+
+def owned_host_copy(tree: Pytree) -> Pytree:
+    """`jax.device_get` whose result is safe to hold across donated
+    dispatches when the persistent cache is active.
+
+    On CPU, device_get returns zero-copy numpy VIEWS of the XLA buffers.
+    Executables DESERIALIZED from the persistent compilation cache donate
+    those buffers in place even while a view is alive (jaxlib 0.4.37 —
+    fresh-compiled executables copy instead when external references
+    exist), so a host "snapshot" would silently track the live state. One
+    owned copy per leaf breaks the aliasing; skipped when the cache is off
+    (no deserialized executables, the views behave). The ONE site holding
+    this workaround's knowledge — the rollback snapshot and the trainer's
+    multi-process histogram capture both call it."""
+    host = jax.device_get(tree)
+    if not persistent_cache_active():
+        return host
+    import numpy as np
+
+    return jax.tree_util.tree_map(lambda x: np.array(x, copy=True), host)
 
 
 def has_restorable_checkpoint(directory: str) -> bool:
@@ -112,6 +232,15 @@ class Checkpointer:
         self.save_interval_secs = save_interval_secs
         self.save_interval_steps = save_interval_steps
         self._next_save = time.time() + save_interval_secs
+        # checksum-pass parallelism for the fused verified restore; the
+        # env override exists for hosts whose storage saturates earlier
+        self.verify_threads = max(1, int(os.environ.get(
+            "DCGAN_CKPT_VERIFY_THREADS", "4")))
+        # {"files","bytes_read","bytes_cached","verify_ms","restore_ms"}
+        # of the last successful VERIFIED restore (None when the restore
+        # was unverified or never happened) — the trainer's startup report
+        # and tools/bench_startup.py read it
+        self.last_restore_stats: Optional[Dict[str, float]] = None
 
     def save(self, step: int, state: Pytree, *, force: bool = False) -> None:
         self._mgr.save(int(step),
@@ -184,56 +313,131 @@ class Checkpointer:
 
             retry_io(_write, tag="ckpt-manifest")
 
-    def _verify_step(self, step: int) -> Tuple[bool, str]:
-        """Check a finalized step dir against its manifest. No manifest =
-        trusted (legacy dirs and crash-before-manifest saves keep the seed's
-        restore semantics — verification only ever adds protection).
-
-        Every read here runs under utils/retry.retry_io: a verification
-        FAILURE permanently condemns the step (`.corrupt` rename), so a
-        transient IO blip — an NFS hiccup mid-checksum, a momentarily
-        unreadable manifest — must get its bounded retries before the
-        verdict. Only an error that SURVIVES the retries counts as
-        evidence against the bytes."""
+    def _manifest_files(self, step: int
+                        ) -> Tuple[Optional[Dict[str, Dict[str, int]]], str]:
+        """The step's manifest file table, or (None, why) when the step
+        restores UNVERIFIED (no manifest: legacy dirs and crash-before-
+        manifest saves keep the seed's restore semantics; an unreadable
+        manifest is a manifest-side problem, not evidence against the
+        arrays). Manifest IO runs under retry_io — a verification failure
+        permanently condemns a step, so transient blips get their bounded
+        retries before any verdict."""
         from dcgan_tpu.utils.retry import retry_io
 
         path = self._manifest_path(step)
         if not os.path.exists(path):
-            return True, "no integrity manifest (unverified)"
+            return None, "no integrity manifest (unverified)"
 
         def _read_manifest():
             with open(path) as f:
                 return json.load(f)
 
         try:
-            manifest = retry_io(_read_manifest, tag="ckpt-verify")
-            files = manifest["files"]
+            return retry_io(_read_manifest, tag="ckpt-verify")["files"], \
+                "manifest"
         except (OSError, ValueError, KeyError) as e:
-            # an unreadable manifest is a manifest-side problem, not
-            # evidence against the arrays — trust the step, say so
-            return True, f"unreadable integrity manifest ({e})"
+            return None, f"unreadable integrity manifest ({e})"
+
+    def _stat_precheck(self, step: int,
+                       files: Dict[str, Dict[str, int]]) -> Optional[str]:
+        """Metadata-only screen, tree order: a manifest-listed file that is
+        missing or the wrong SIZE is deterministic corruption (truncation /
+        deletion — the dominant real-world classes), caught from stat calls
+        before a single payload byte is read or any restore collective is
+        dispatched. Returns the failure reason or None.
+
+        Retry semantics mirror PR 4's verify fix: a missing file condemns
+        immediately (deterministic), but any other stat OSError — an NFS
+        hiccup, a momentary EIO — gets retry_io's bounded retries before
+        the verdict, because a failing screen permanently quarantines the
+        step."""
+        from dcgan_tpu.utils.retry import retry_io
+
         step_dir = os.path.join(self.directory, str(step))
         for rel, rec in files.items():
             fpath = os.path.join(step_dir, rel)
-            if not os.path.exists(fpath):
-                # a manifest-listed file that is GONE is deterministic
-                # corruption (truncation/deletion) — condemn immediately
-                # rather than retry-with-backoff a FileNotFoundError and
-                # mislog it as transient
-                return False, f"missing file {rel!r}"
             try:
-                size, crc = retry_io(
-                    lambda p=fpath: _file_checksum(p), tag="ckpt-verify")
+                size = os.stat(fpath).st_size
             except FileNotFoundError:
-                return False, f"missing file {rel!r}"
-            except OSError as e:
-                return False, f"unreadable file {rel!r} ({e})"
+                return f"missing file {rel!r}"
+            except OSError:
+                try:
+                    size = retry_io(lambda p=fpath: os.stat(p).st_size,
+                                    tag="ckpt-verify")
+                except OSError as e:
+                    return f"unreadable file {rel!r} ({e})"
             if size != rec["size"]:
-                return False, (f"size mismatch on {rel!r} "
-                               f"({size} != {rec['size']})")
+                return (f"size mismatch on {rel!r} "
+                        f"({size} != {rec['size']})")
+        return None
+
+    def _crc_pass(self, step: int, files: Dict[str, Dict[str, int]]
+                  ) -> Tuple[bool, str, Dict[str, float]]:
+        """Thread-pooled checksum pass over the manifest's files in tree
+        (sorted-path) order: (ok, why, stats). Reads stream through the
+        fingerprint cache, so bytes this process already hashed (the save-
+        time manifest write, an earlier verify) are not re-read; fresh
+        reads run under retry_io so only an error that survives the bounded
+        retries counts as evidence against the bytes. The verdict reports
+        the FIRST failing file in tree order — deterministic across the
+        pool's scheduling."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from dcgan_tpu.utils.retry import retry_io
+
+        step_dir = os.path.join(self.directory, str(step))
+        t0 = time.perf_counter()
+
+        def _one(item):
+            rel, rec = item
+            fpath = os.path.join(step_dir, rel)
+            try:
+                size, crc, cached = retry_io(
+                    lambda p=fpath: _file_checksum_cached(p),
+                    tag="ckpt-verify")
+            except FileNotFoundError:
+                return f"missing file {rel!r}", 0, 0
+            except OSError as e:
+                return f"unreadable file {rel!r} ({e})", 0, 0
+            if size != rec["size"]:
+                return (f"size mismatch on {rel!r} "
+                        f"({size} != {rec['size']})"), 0, 0
             if crc != rec["crc32"]:
-                return False, f"crc32 mismatch on {rel!r}"
-        return True, "verified"
+                return f"crc32 mismatch on {rel!r}", 0, 0
+            return None, (0 if cached else size), (size if cached else 0)
+
+        items = list(files.items())
+        n = min(self.verify_threads, max(1, len(items)))
+        if n > 1:
+            with ThreadPoolExecutor(max_workers=n,
+                                    thread_name_prefix="ckpt-crc") as pool:
+                results = list(pool.map(_one, items))
+        else:
+            results = [_one(i) for i in items]
+        stats = {
+            "files": float(len(items)),
+            "bytes_read": float(sum(r[1] for r in results)),
+            "bytes_cached": float(sum(r[2] for r in results)),
+            "verify_ms": (time.perf_counter() - t0) * 1e3,
+        }
+        for why, _, _ in results:
+            if why is not None:
+                return False, why, stats
+        return True, "verified", stats
+
+    def _verify_step(self, step: int) -> Tuple[bool, str]:
+        """Check a finalized step dir against its manifest: metadata screen
+        first (missing/truncated files condemn with zero payload reads),
+        then the thread-pooled checksum pass. No manifest = trusted —
+        verification only ever adds protection."""
+        files, why = self._manifest_files(step)
+        if files is None:
+            return True, why
+        bad = self._stat_precheck(step, files)
+        if bad is not None:
+            return False, bad
+        ok, why, _ = self._crc_pass(step, files)
+        return ok, why
 
     def _mark_corrupt(self, step: int, why: str) -> None:
         """Rename a failing step dir to `<step>.corrupt` (chief-only): the
@@ -377,7 +581,25 @@ class Checkpointer:
         a manifest restore exactly as before (unverified), and restore-time
         exceptions still propagate — only MANIFEST-proven corruption
         quarantines a step, so a tree/shape mismatch can never silently
-        retire good checkpoints."""
+        retire good checkpoints.
+
+        SINGLE-PASS (ISSUE 5): bulk verification is fused with the restore
+        instead of preceding it. The stat pre-check screens out truncation
+        with zero payload reads; small files (the format's structural
+        metadata) CRC-verify before the native parser sees them; then the
+        thread-pooled checksum pass over the bulk array chunks runs on
+        background threads while THIS thread (the one that must own the
+        multi-host restore collective) runs Orbax's leaf payload read of
+        the same files — bytes come off storage once (the verifier's read
+        warms the page cache the payload read is served from) and restore
+        wall-clock is max(verify, restore) instead of their sum. The
+        restored tree is RETURNED only after a clean checksum verdict; a
+        failing verdict discards it and falls back, and a restore
+        exception is re-raised only when the checksums PASSED (on a step
+        whose checksums fail, the exception is just corruption showing up
+        twice). Verdicts stay deterministic across processes — every
+        process hashes the same shared-filesystem bytes — so the
+        quarantine/fallback branch is taken symmetrically, like before."""
         abstract = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=getattr(x, "sharding",
@@ -385,12 +607,69 @@ class Checkpointer:
             if hasattr(x, "shape") else x,
             target_state)
         for step in self._finalized_steps():
-            ok, why = self._verify_step(step)
-            if not ok:
-                self._mark_corrupt(step, why)
+            files, why = self._manifest_files(step)
+            if files is None:
+                # unverified restore (legacy/unreadable-manifest step):
+                # exactly the seed's semantics, exceptions propagate
+                restored = self._mgr.restore(
+                    step, args=self._ocp.args.StandardRestore(abstract))
+                return _rebase_onto_xla_buffers(restored) \
+                    if persistent_cache_active() else restored
+            bad = self._stat_precheck(step, files)
+            if bad is not None:
+                self._mark_corrupt(step, bad)
                 continue
-            return self._mgr.restore(
-                step, args=self._ocp.args.StandardRestore(abstract))
+            # structural metadata (small files: OCDBT manifests, _METADATA,
+            # sharding records) verifies BEFORE the native parser ever sees
+            # it — only the bulk array chunks, which dominate restore IO,
+            # fuse their verification with the payload read
+            small = {r: rec for r, rec in files.items()
+                     if rec["size"] <= _PREPARSE_VERIFY_MAX_BYTES}
+            large = {r: rec for r, rec in files.items()
+                     if rec["size"] > _PREPARSE_VERIFY_MAX_BYTES}
+            ok, vwhy, stats = self._crc_pass(step, small)
+            if not ok:
+                self._mark_corrupt(step, vwhy)
+                continue
+            verdict: List = []
+            verifier = None
+            if large:
+                verifier = threading.Thread(
+                    target=lambda: verdict.extend(
+                        self._crc_pass(step, large)),
+                    name="ckpt-verify", daemon=True)
+            t0 = time.perf_counter()
+            if verifier is not None:
+                verifier.start()
+            restored, restore_err = None, None
+            try:
+                restored = self._mgr.restore(
+                    step, args=self._ocp.args.StandardRestore(abstract))
+            except Exception as e:  # verdict decides if this is corruption
+                restore_err = e
+            restore_ms = (time.perf_counter() - t0) * 1e3
+            if verifier is not None:
+                verifier.join()
+                if not verdict:  # verifier died before producing a verdict
+                    if restore_err is not None:
+                        raise restore_err
+                    raise RuntimeError(
+                        f"checkpoint verifier died without a verdict on "
+                        f"step {step}")
+                ok, vwhy, big_stats = verdict
+                for k in ("files", "bytes_read", "bytes_cached",
+                          "verify_ms"):
+                    stats[k] += big_stats[k]
+                if not ok:
+                    restored = None  # corrupt bytes — never hand them out
+                    self._mark_corrupt(step, vwhy)
+                    continue
+            if restore_err is not None:
+                raise restore_err
+            stats["restore_ms"] = restore_ms
+            self.last_restore_stats = stats
+            return _rebase_onto_xla_buffers(restored) \
+                if persistent_cache_active() else restored
         return None
 
     def wait(self) -> None:

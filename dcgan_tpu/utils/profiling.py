@@ -36,6 +36,7 @@ tools/bench_trainer_loop.py's occupancy mode records it on/off).
 from __future__ import annotations
 
 import collections
+import contextlib
 import time
 from typing import Dict, Optional
 
@@ -94,6 +95,52 @@ class StepTimer:
             out[f"{prefix}host_ms_mean"] = 1e3 * host_mean
             out[f"{prefix}dispatch_occupancy"] = \
                 host_mean / mean if mean > 0 else 0.0
+        return out
+
+
+class StartupProfile:
+    """Named-phase wall-clock breakdown of time-to-first-step (ISSUE 5).
+
+    The trainer brackets each startup phase (`init`, `restore`, `data`,
+    `warmup`) with `phase()` and stamps `first_step()` at the first proven
+    device-progress point; `summary()` is the breakdown the warm-start
+    bench (tools/bench_startup.py) A/Bs cold-vs-warm. Phases are additive
+    and disjoint; `total_ms` runs from construction to the first-step
+    stamp, so untracked gaps (imports inside phases, loader thread spin-up)
+    are visible as total minus the named parts rather than hidden.
+    """
+
+    def __init__(self) -> None:
+        self._t0 = time.perf_counter()
+        self._phases: Dict[str, float] = {}
+        self._first_step_ms: Optional[float] = None
+
+    def phase(self, name: str):
+        """Context manager accumulating wall time under `name`."""
+        @contextlib.contextmanager
+        def _cm():
+            t0 = time.perf_counter()
+            try:
+                yield self
+            finally:
+                self._phases[name] = self._phases.get(name, 0.0) \
+                    + (time.perf_counter() - t0) * 1e3
+        return _cm()
+
+    def first_step(self) -> None:
+        """Stamp the first completed training step (idempotent — the first
+        call wins; later materializations are steady state)."""
+        if self._first_step_ms is None:
+            self._first_step_ms = (time.perf_counter() - self._t0) * 1e3
+
+    @property
+    def done(self) -> bool:
+        return self._first_step_ms is not None
+
+    def summary(self, prefix: str = "perf/startup/") -> Dict[str, float]:
+        out = {f"{prefix}{k}_ms": v for k, v in self._phases.items()}
+        if self._first_step_ms is not None:
+            out[f"{prefix}total_ms"] = self._first_step_ms
         return out
 
 

@@ -20,6 +20,7 @@ pytestmark = pytest.mark.slow  # see pytest.ini: excluded from the smoke tier
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from dcgan_tpu.utils.backend import shard_map
 from dcgan_tpu.models.dcgan import (
     discriminator_apply,
     gan_init,
@@ -64,7 +65,7 @@ class TestRingAttention:
         full = full_attention(q, k, v, scale=scale)
         mesh = ring_mesh(n)
         spec = P(None, "model", None)
-        ring = jax.jit(jax.shard_map(
+        ring = jax.jit(shard_map(
             functools.partial(ring_attention, axis_name="model", n_shards=n,
                               scale=scale),
             mesh=mesh, in_specs=(spec,) * 3, out_specs=spec))(q, k, v)
@@ -81,7 +82,7 @@ class TestRingAttention:
             return jnp.sum(full_attention(q, k, v, scale=scale) ** 2)
 
         def loss_ring(q, k, v):
-            f = jax.shard_map(
+            f = shard_map(
                 functools.partial(ring_attention, axis_name="model",
                                   n_shards=4, scale=scale),
                 mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)

@@ -287,6 +287,8 @@ class TestNewKnobs:
 
         from dcgan_tpu.train import trainer
 
-        src = inspect.getsource(trainer._train)
+        # _train_run is the run body (PR 5 split _train into a setup
+        # wrapper owning the compile-cache monitor's lifetime + this)
+        src = inspect.getsource(trainer._train_run)
         assert "single-process only" not in src
         assert "device_resident=jax.process_count() > 1" in src

@@ -13,6 +13,7 @@ import pytest
 pytestmark = pytest.mark.slow  # see pytest.ini: excluded from the smoke tier
 
 from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from dcgan_tpu.utils.backend import shard_map
 from dcgan_tpu.ops.attention import (
     attn_apply,
     attn_init,
@@ -115,10 +116,10 @@ class TestRingFlash:
 
     def _smap(self, fn, n):
         mesh, spec = self._mesh_and_spec(n)
-        # check_vma=False: pallas_call outputs carry no vma annotations
+        # check=False: pallas_call outputs carry no vma annotations
         # (same constraint as attn_apply's seq-parallel pallas routing)
-        return jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
-                             out_specs=spec, check_vma=False)
+        return shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+                             out_specs=spec, check=False)
 
     def test_forward_matches_dense_and_ring(self):
         import functools

@@ -312,6 +312,37 @@ class TestChaosDrillSmoke:
         assert elapsed < 300, f"multihost smoke took {elapsed:.0f}s"
 
 
+@pytest.mark.chaos
+class TestBenchStartupSmoke:
+    """tools/bench_startup.py --smoke pinned into tier-1 (ISSUE 5,
+    mirroring the chaos_drill pattern): the cold-vs-warm trainer A/B must
+    keep proving the warm-start invariants end to end through real trainer
+    subprocesses — warm compile strictly lower with a primed cache, zero
+    warm cache misses, and the fused verified restore reading each
+    manifest byte exactly once — inside an explicit runtime budget so the
+    pin can never quietly eat the tier. The full-size run is standalone:
+    `JAX_PLATFORMS=cpu python tools/bench_startup.py`."""
+
+    def test_cold_warm_ab_passes_within_budget(self):
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "tools/bench_startup.py", "--smoke"], cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        elapsed = time.monotonic() - t0
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
+        row = json.loads(res.stdout.strip().splitlines()[-1])
+        assert row["label"] == "bench-startup" and row["ok"] is True
+        assert row["checks"]["warm_compile_strictly_lower"]
+        assert row["checks"]["warm_zero_misses"]
+        assert row["checks"]["restore_bytes_read_once"]
+        assert row["warm"]["cache"]["hits"] > 0
+        # two tiny trainer subprocesses; ~4x measured cost on a quiet host
+        assert elapsed < 240, f"bench_startup smoke took {elapsed:.0f}s"
+
+
 @pytest.mark.slow
 class TestToolsRunOnCpu:
     def test_loader_scale_two_processes(self):

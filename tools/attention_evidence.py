@@ -56,6 +56,7 @@ def main() -> None:
         + f" --xla_force_host_platform_device_count={args.mesh}")
 
     import jax
+    from dcgan_tpu.utils.backend import shard_map
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
@@ -139,11 +140,11 @@ def main() -> None:
             "all_gather": defs("all-gather"),
         }
 
-    ring = jax.shard_map(
+    ring = shard_map(
         functools.partial(ring_attention, axis_name="model", n_shards=n,
                           scale=scale),
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
-    uly = jax.shard_map(
+    uly = shard_map(
         functools.partial(ulysses_attention, axis_name="model", n_shards=n,
                           num_heads=heads, scale=scale),
         mesh=mesh, in_specs=(P("data", "model", None),) * 3,

@@ -50,6 +50,7 @@ def main() -> None:
     args = p.parse_args()
 
     import jax
+    from dcgan_tpu.utils.backend import shard_map
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
@@ -88,7 +89,7 @@ def main() -> None:
         spec = P("data", "model", None)
 
         def smap(fn):
-            f = jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+            f = shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
                               out_specs=spec)
             return f
 
@@ -109,7 +110,7 @@ def main() -> None:
                     .reshape(B, k.shape[1], -1)
                 vv = v.reshape(B, h, *v.shape[1:]).transpose(0, 2, 1, 3) \
                     .reshape(B, v.shape[1], -1)
-                out = jax.shard_map(
+                out = shard_map(
                     functools.partial(ulysses_attention, axis_name="model",
                                       n_shards=args.mesh, num_heads=h,
                                       scale=scale),

@@ -1,0 +1,190 @@
+"""Cold-vs-warm startup A/B: is time-to-first-step tracked and improving?
+
+PRs 3-4 made restarts the NORMAL response to faults (watchdog exit,
+coordinated preemption stop, rollback), so startup cost is recurring
+throughput cost, not a one-off. This tool runs the real trainer entry twice
+against one persistent compile cache:
+
+  arm "cold": fresh cache dir + fresh checkpoint dir — every program
+      compiles, the cache is primed, a final checkpoint lands;
+  arm "warm": same cache dir, same checkpoint dir — the restart path:
+      programs deserialize from the primed cache, the checkpoint restores
+      through the fused single-pass verified read.
+
+and emits ONE BENCH-style JSON line with each arm's startup breakdown
+(init / data / restore / compile / time-to-first-step, parsed from the
+trainer's own `perf/startup/*` event) plus the pass/fail of the warm-start
+invariants it exists to pin:
+
+  - compile phase strictly lower warm than cold, with zero cache misses
+    and nonzero hits on the warm arm (the cache actually served);
+  - restore bytes read once: the warm arm's verified restore reads each
+    manifest byte at most once through the checksum layer
+    (bytes_read + bytes_cached == manifest total, no double pass).
+
+`--smoke` shrinks the model and step count to the tier-1 budget
+(test_tools pins it, mirroring the chaos_drill pattern); the full-size run
+is the standalone capture. CPU-only by design — chip startup trajectory is
+bench.py's `startup_ms` field; this tool certifies the MECHANISM.
+
+    JAX_PLATFORMS=cpu python tools/bench_startup.py [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STARTUP_PREFIX = "perf/startup/"
+
+
+def _run_arm(name: str, *, workdir: str, cache_dir: str, ckpt_dir: str,
+             max_steps: int, size: int, batch: int, timeout: float) -> dict:
+    """One trainer subprocess; returns its parsed perf/ startup event."""
+    argv = [
+        sys.executable, "-m", "dcgan_tpu.train",
+        "--synthetic",
+        "--max_steps", str(max_steps),
+        "--batch_size", str(batch),
+        "--output_size", str(size),
+        "--gf_dim", "8", "--df_dim", "8",
+        "--compile_cache_dir", cache_dir,
+        "--aot_warmup", "true",
+        "--sample_every_steps", "0",
+        "--activation_summary_steps", "0",
+        "--save_summaries_secs", "0",
+        "--save_model_secs", "1e9",
+        "--no_tensorboard",
+        "--checkpoint_dir", ckpt_dir,
+        "--sample_dir", os.path.join(workdir, f"samples-{name}"),
+    ]
+    t0 = time.perf_counter()
+    res = subprocess.run(argv, cwd=REPO,
+                         env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                         capture_output=True, text=True, timeout=timeout)
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    if res.returncode != 0:
+        raise RuntimeError(
+            f"{name} trainer rc={res.returncode}: "
+            f"{(res.stderr or '')[-800:]}")
+    startup = None
+    with open(os.path.join(ckpt_dir, "events.jsonl")) as f:
+        for line in f:
+            e = json.loads(line)
+            if e["kind"] == "scalars" and \
+                    f"{STARTUP_PREFIX}total_ms" in e["values"]:
+                startup = e["values"]
+    if startup is None:
+        raise RuntimeError(f"{name}: no {STARTUP_PREFIX} event in "
+                           f"{ckpt_dir}/events.jsonl")
+    perf = {k: v for k, v in startup.items() if k.startswith("perf/")}
+    return {"wall_ms": wall_ms, "resumed": "restored checkpoint"
+            in (res.stdout or ""), "perf": perf}
+
+
+def _breakdown(arm: dict) -> dict:
+    """The BENCH-style phase row for one arm (ms, rounded)."""
+    p = arm["perf"]
+
+    def g(k):
+        return round(p.get(STARTUP_PREFIX + k + "_ms", 0.0), 1)
+
+    return {
+        "init_ms": g("init"),
+        "data_ms": g("data"),
+        "restore_ms": g("restore"),
+        "compile_ms": g("warmup"),
+        "time_to_first_step_ms": round(
+            p.get(STARTUP_PREFIX + "total_ms", 0.0), 1),
+        "process_wall_ms": round(arm["wall_ms"], 1),
+        "cache": {k: int(p.get(f"perf/compile_cache_{k}", 0))
+                  for k in ("requests", "hits", "misses")},
+        "compile_ms_per_program": {
+            k[len("perf/compile_ms/"):]: round(v, 1)
+            for k, v in p.items() if k.startswith("perf/compile_ms/")},
+    }
+
+
+def _manifest_bytes(ckpt_dir: str, step: int) -> float:
+    """Total manifest-listed bytes of `step`'s integrity manifest — the
+    step the warm arm restored, so the read-once check compares the verify
+    layer's byte count against exactly the bytes it was verifying."""
+    path = os.path.join(ckpt_dir, "integrity", f"{step}.json")
+    with open(path) as f:
+        return float(sum(rec["size"]
+                         for rec in json.load(f)["files"].values()))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes + short budget (the tier-1 pin)")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-arm subprocess budget (seconds)")
+    args = ap.parse_args()
+    size, batch, steps = (16, 8, 3) if args.smoke else (64, 16, 5)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        cache = os.path.join(tmp, "compile-cache")
+        ckpt = os.path.join(tmp, "ckpt")
+        cold = _run_arm("cold", workdir=tmp, cache_dir=cache, ckpt_dir=ckpt,
+                        max_steps=steps, size=size, batch=batch,
+                        timeout=args.timeout)
+        # the cold arm's final save is at `steps` — the step warm restores
+        manifest_bytes = _manifest_bytes(ckpt, steps)
+        warm = _run_arm("warm", workdir=tmp, cache_dir=cache, ckpt_dir=ckpt,
+                        max_steps=2 * steps, size=size, batch=batch,
+                        timeout=args.timeout)
+
+    c, w = _breakdown(cold), _breakdown(warm)
+    wp = warm["perf"]
+    verify_read = wp.get("perf/restore/verify_bytes", -1.0)
+    verify_cached = wp.get("perf/restore/verify_cached_bytes", 0.0)
+    checks = {
+        # the cache actually served the restart: no program recompiled
+        "warm_compile_strictly_lower": w["compile_ms"] < c["compile_ms"],
+        "warm_zero_misses": w["cache"]["misses"] == 0,
+        "warm_has_hits": w["cache"]["hits"] > 0,
+        "cold_has_misses": c["cache"]["misses"] > 0,
+        # the warm arm resumed from the cold arm's final checkpoint through
+        # the fused verified restore, reading each manifest byte ONCE
+        "warm_resumed": warm["resumed"],
+        "restore_verified": wp.get("perf/restore/verify_files", 0) > 0,
+        "restore_bytes_read_once":
+            0 <= verify_read <= manifest_bytes
+            and verify_read + verify_cached == manifest_bytes,
+    }
+    row = {
+        "label": "bench-startup",
+        "platform": "cpu",
+        "model": f"dcgan{size}", "batch": batch, "steps": steps,
+        "cold": c,
+        "warm": w,
+        "restore": {
+            "manifest_bytes": manifest_bytes,
+            "verify_bytes_read": verify_read,
+            "verify_bytes_cached": verify_cached,
+            "verify_ms": round(wp.get("perf/restore/verify_ms", 0.0), 1),
+        },
+        "speedup": {
+            "compile_ms": round(c["compile_ms"] / max(w["compile_ms"], 1e-9),
+                                2),
+            "time_to_first_step": round(
+                c["time_to_first_step_ms"]
+                / max(w["time_to_first_step_ms"], 1e-9), 2),
+        },
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    print(json.dumps(row))
+    sys.exit(0 if row["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()
