@@ -246,6 +246,42 @@ def main() -> None:
         final_d_loss = float(metrics["d_loss"])  # hard sync ends the window
         dt = min(dt, time.perf_counter() - t0)
 
+    # devstep_ms (ISSUE 6): the device's OWN step time from a short trace
+    # digest — host wall-clock rows over the tunneled transport carry RPC
+    # noise the device timeline does not, so BENCH rows now pin both.
+    # Best-effort: a failed capture leaves the field null, never the row.
+    devstep_ms = None
+    if os.environ.get("BENCH_DEVSTEP", "1") != "0":
+        try:
+            import tempfile
+
+            from dcgan_tpu.utils.trace import devstep_ms as devstep_of
+            with tempfile.TemporaryDirectory() as td:
+                jax.profiler.start_trace(td)
+                try:
+                    # stop_trace in the finally: a raise inside the traced
+                    # region must not leave the profiler active for the
+                    # rest of the process (any later start_trace would
+                    # fail, and it would trace into a deleted tempdir)
+                    if SCAN > 1:
+                        keys = jax.random.split(
+                            jax.random.fold_in(base, step_idx), SCAN)
+                        state, metrics = pt.multi_step(state, imgs_k, keys,
+                                                       *labels_k)
+                    else:
+                        for _ in range(min(5, STEPS_MEASURE)):
+                            state, metrics = pt.step(
+                                state, images,
+                                jax.random.fold_in(base, step_idx), *labels)
+                            step_idx += 1
+                    # device work lands inside the trace
+                    float(metrics["d_loss"])
+                finally:
+                    jax.profiler.stop_trace()
+                devstep_ms = devstep_of(td, per_exec=max(1, SCAN))
+        except Exception as e:  # noqa: BLE001 — the field is optional
+            print(f"devstep capture failed: {e!r}", file=sys.stderr)
+
     img_per_sec = cfg.batch_size * steps_window / dt
     img_per_sec_chip = img_per_sec / n_chips
     if preset_name:
@@ -261,6 +297,10 @@ def main() -> None:
         "unit": "images/sec/chip",
         "vs_baseline": round(img_per_sec_chip / V100_TF_BASELINE_IMG_PER_SEC, 3),
         "startup_ms": round(startup_ms, 1),
+        # the device timeline's median per-step program time (null when
+        # the capture failed); host ms_per_step minus this is transport +
+        # host overhead, the split the captures log could not see before
+        "devstep_ms": round(devstep_ms, 4) if devstep_ms else None,
     }
     if cfg.model.attn_res:
         # Attention-bearing configs stamp the generation of the attention

@@ -472,10 +472,42 @@ class TrainConfig:
 
     # Profiling (SURVEY.md §5 — the reference has none; jax.profiler + step
     # timing is the named TPU-native equivalent)
-    profile_dir: str = ""          # non-empty enables trace capture
+    profile_dir: str = ""          # non-empty enables the scheduled trace
+                                   # capture window
     profile_start_step: int = 10   # skip compile + warmup steps
     profile_num_steps: int = 5
+    profile_trigger: str = ""      # non-empty: on-demand tracing (ISSUE 6)
+                                   # — touch this file mid-run to capture
+                                   # the next profile_num_steps steps, no
+                                   # restart needed; the file is deleted as
+                                   # the ack (touch again for another
+                                   # capture). Each capture is digested
+                                   # in-process on the services worker into
+                                   # perf/device/* events (compute ms,
+                                   # collective ms, idle-gap ms, devstep).
+                                   # Traces land in profile_dir, or
+                                   # checkpoint_dir/trace when unset
     timing_window: int = 50        # sliding window for step-time stats
+    flight_recorder_steps: int = 64  # crash flight recorder (ISSUE 6):
+                                   # ring of the last K per-step telemetry
+                                   # records (step/host ms, losses, services
+                                   # queue + drops, gate verdicts, recovery
+                                   # counters), dumped as a standalone
+                                   # JSONL file on watchdog trip, NaN
+                                   # abort, coordinated stop, or uncaught
+                                   # exception. Crash-path-only IO — the
+                                   # default event stream is untouched.
+                                   # 0 = off
+    fleet_health_steps: int = 0    # >0: every N steps allgather a compact
+                                   # per-host health vector on the dispatch
+                                   # thread (collective-thread rule) and
+                                   # chief-materialize fleet/* metrics —
+                                   # straggler skew (max/min step_ms),
+                                   # slowest host, queue/drop/recovery
+                                   # totals; the slowest host is also named
+                                   # in a watchdog trip header. One small
+                                   # collective per N steps. 0 = off
+                                   # (parity)
 
     # Misc
     seed: int = 0
@@ -586,6 +618,14 @@ class TrainConfig:
             raise ValueError(
                 f"max_corrupt_records must be >= 0, got "
                 f"{self.max_corrupt_records}")
+        if self.flight_recorder_steps < 0:
+            raise ValueError(
+                f"flight_recorder_steps must be >= 0, got "
+                f"{self.flight_recorder_steps}")
+        if self.fleet_health_steps < 0:
+            raise ValueError(
+                f"fleet_health_steps must be >= 0, got "
+                f"{self.fleet_health_steps}")
         if self.steps_per_call < 1:
             raise ValueError(
                 f"steps_per_call must be >= 1, got {self.steps_per_call}")
@@ -597,6 +637,10 @@ class TrainConfig:
                 "nan_check_steps": self.nan_check_steps,
                 "save_model_steps": self.save_model_steps,
                 "fid_every_steps": self.fid_every_steps,
+                # the health gather is a per-cadence COLLECTIVE — a skewed
+                # firing subset would deadlock multi-host, same as the
+                # activation-summary reasoning
+                "fleet_health_steps": self.fleet_health_steps,
             }
             if self.nan_policy == "rollback":
                 # the snapshot cadence is inert under the default policy —

@@ -234,8 +234,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="capture a jax.profiler trace into this dir")
     p.add_argument("--profile_start_step", type=int, default=10)
     p.add_argument("--profile_num_steps", type=int, default=5)
+    p.add_argument("--profile_trigger", default="",
+                   help="on-demand tracing: touch this file mid-run to "
+                        "capture the next --profile_num_steps steps (the "
+                        "file is deleted as the ack; touch again for "
+                        "another capture); each capture is digested into "
+                        "perf/device/* events — compute/collective/"
+                        "idle-gap ms and the device's own step time")
     p.add_argument("--timing_window", type=int, default=50,
                    help="sliding window (steps) for step-time stats")
+    p.add_argument("--flight_recorder_steps", type=int, default=64,
+                   help="crash flight recorder: ring of the last K "
+                        "per-step telemetry records dumped as JSONL on "
+                        "watchdog trip / NaN abort / coordinated stop / "
+                        "uncaught exception (crash-path-only IO; 0 = off)")
+    p.add_argument("--fleet_health_steps", type=int, default=0,
+                   help=">0: allgather a compact per-host health vector "
+                        "every N steps and write fleet/* metrics — "
+                        "straggler skew (max/min step_ms), slowest host, "
+                        "queue/drop/recovery totals (0 = off)")
     # mesh (replaces ps_hosts/worker_hosts/job_name/task_index,
     # image_train.py:27-36)
     p.add_argument("--mesh_data", type=int, default=-1,
@@ -322,6 +339,9 @@ _FLAG_FIELDS = {
     "profile_dir": ("", "profile_dir"),
     "profile_start_step": ("", "profile_start_step"),
     "profile_num_steps": ("", "profile_num_steps"),
+    "profile_trigger": ("", "profile_trigger"),
+    "flight_recorder_steps": ("", "flight_recorder_steps"),
+    "fleet_health_steps": ("", "fleet_health_steps"),
     "timing_window": ("", "timing_window"), "seed": ("", "seed"),
     "arch": ("model", "arch"),
     "output_size": ("model", "output_size"), "c_dim": ("model", "c_dim"),

@@ -65,6 +65,64 @@ def _allgather_i32(value: int) -> np.ndarray:
     return np.asarray(gathered).reshape(-1)
 
 
+def _allgather_f32(vec: np.ndarray) -> np.ndarray:
+    """One float32 vector from every process, index-ordered [P, F] — the
+    fleet-health transport (ISSUE 6). Module-level like `_allgather_i32`
+    so tests shim it without a real multi-process job."""
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(
+        np.asarray(vec, np.float32))
+    return np.asarray(gathered).reshape(jax.process_count(), -1)
+
+
+#: order of the per-host fleet health vector's slots (ISSUE 6). Every
+#: process builds its local vector in this order on the DISPATCH thread
+#: (collective-thread rule: the allgather below is a mesh-wide collective)
+#: and the chief materializes the fleet/* metrics from the gathered table.
+HEALTH_FIELDS = ("step", "step_ms_mean", "host_ms_mean", "queue_depth",
+                 "dropped", "rollbacks", "corrupt_records")
+
+
+def fleet_health_gather(vec) -> np.ndarray:
+    """Allgather one health vector per host -> [P, F] table, identical on
+    every process. Single-process: the local vector as a 1-row table, no
+    collective — the same shape so the metric path is testable on CPU."""
+    local = np.asarray(vec, np.float32).reshape(1, -1)
+    if jax.process_count() == 1:
+        return local
+    return _allgather_f32(local.ravel())
+
+
+def fleet_metrics(table: np.ndarray) -> Tuple[dict, str]:
+    """(fleet/* scalar row, slowest-host note) from a gathered [P, F]
+    health table (HEALTH_FIELDS order).
+
+    Straggler skew is max/min of the per-host windowed step_ms_mean — the
+    fleet-level number "Scalable Training of LMs using pjit" treats as a
+    first-class operational signal; the note names the slowest host so a
+    watchdog trip header can point at the likely wedged peer.
+    """
+    table = np.asarray(table, np.float64)
+    ms = table[:, HEALTH_FIELDS.index("step_ms_mean")]
+    slowest = int(np.argmax(ms))
+    col = {name: table[:, i] for i, name in enumerate(HEALTH_FIELDS)}
+    row = {
+        "fleet/step_ms_max": float(ms.max()),
+        "fleet/step_ms_min": float(ms.min()),
+        "fleet/step_ms_skew": float(ms.max() - ms.min()),
+        "fleet/slowest_host": float(slowest),
+        "fleet/host_ms_max": float(col["host_ms_mean"].max()),
+        "fleet/queue_depth_max": float(col["queue_depth"].max()),
+        "fleet/dropped_total": float(col["dropped"].sum()),
+        "fleet/rollbacks_total": float(col["rollbacks"].sum()),
+        "fleet/corrupt_total": float(col["corrupt_records"].sum()),
+    }
+    note = (f"slowest host: process {slowest} "
+            f"(step_ms_mean {ms[slowest]:.1f} vs fleet min {ms.min():.1f})")
+    return row, note
+
+
 def anomaly_consensus(local_bad: bool) -> Tuple[bool, List[int]]:
     """Agree on the NaN-gate verdict: (any process tripped, which ones).
 
@@ -178,11 +236,18 @@ class CollectiveWatchdog:
     better than an accelerator pod wedged in a dead allreduce.
 
     `on_trip(phase, step)` replaces both enforcement layers for unit tests.
+    `pre_dump(phase, step)` runs on ANY trip — real or on_trip — before
+    enforcement: the trainer hangs the flight recorder here (ISSUE 6) so a
+    trip ships the telemetry ring alongside the stacks; it must never
+    raise into the trip path, so failures are swallowed. `set_note()`
+    attaches fleet context (the slowest-host line from the last health
+    gather) to the trip header.
     """
 
     def __init__(self, timeout_secs: float, *,
                  poll_interval: Optional[float] = None,
-                 on_trip: Optional[Callable[[str, int], None]] = None):
+                 on_trip: Optional[Callable[[str, int], None]] = None,
+                 pre_dump: Optional[Callable[[str, int], None]] = None):
         if timeout_secs <= 0:
             raise ValueError(
                 f"timeout_secs must be > 0, got {timeout_secs}")
@@ -191,6 +256,8 @@ class CollectiveWatchdog:
         self._poll = poll_interval if poll_interval is not None \
             else max(0.05, min(1.0, timeout_secs / 4))
         self._on_trip = on_trip
+        self._pre_dump = pre_dump
+        self._note = ""
         self._lock = threading.Lock()
         self._deadline: Optional[float] = None
         self._phase = ""
@@ -236,6 +303,11 @@ class CollectiveWatchdog:
                 else max(0.1, self._deadline - time.monotonic())
                 + (self._backstop_secs - self.timeout_secs))
 
+    def set_note(self, note: str) -> None:
+        """Context line for the trip header (e.g. the fleet health
+        plane's slowest-host attribution); plain assignment — atomic."""
+        self._note = note
+
     def guard(self, phase: str, step: int) -> "_WatchdogGuard":
         return _WatchdogGuard(self, phase, step)
 
@@ -253,6 +325,14 @@ class CollectiveWatchdog:
                     self._step
             if deadline is None or time.monotonic() < deadline:
                 continue
+            if self._pre_dump is not None:
+                # flight-recorder hook: best-effort, BEFORE enforcement —
+                # a failing dump must not stop the trip from killing the
+                # process (the whole point is dying instead of hanging)
+                try:
+                    self._pre_dump(phase, step)
+                except Exception:
+                    pass
             if self._on_trip is not None:
                 self._on_trip(phase, step)
                 self.disarm()  # a test hook keeps the process alive
@@ -261,12 +341,13 @@ class CollectiveWatchdog:
 
     def _dump_and_exit(self, phase: str, step: int) -> None:
         try:
+            note = f" [{self._note}]" if self._note else ""
             print(f"[dcgan_tpu] hung-collective watchdog: process "
                   f"{jax.process_index()} stuck > {self.timeout_secs:.1f}s "
-                  f"in phase {phase!r} at step {step} — dumping all thread "
-                  f"stacks and exiting {WATCHDOG_EXIT_CODE} so the job "
-                  f"restarts from the last checkpoint instead of hanging",
-                  file=sys.stderr, flush=True)
+                  f"in phase {phase!r} at step {step}{note} — dumping all "
+                  f"thread stacks and exiting {WATCHDOG_EXIT_CODE} so the "
+                  f"job restarts from the last checkpoint instead of "
+                  f"hanging", file=sys.stderr, flush=True)
             faulthandler.dump_traceback(file=sys.stderr, all_threads=True)
             sys.stderr.flush()
         finally:
@@ -312,6 +393,9 @@ class _NullWatchdog:
         pass
 
     def disarm(self) -> None:
+        pass
+
+    def set_note(self, note: str) -> None:
         pass
 
     def guard(self, phase: str, step: int):

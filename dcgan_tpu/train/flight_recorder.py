@@ -1,0 +1,137 @@
+"""Crash flight recorder: the telemetry that led up to a failure (ISSUE 6).
+
+PRs 3-5 made the trainer die loudly — watchdog trip (exit 43 + all-thread
+stacks), NaN abort with step context, coordinated stop, services-worker
+surfacing — but every one of those dumps STACKS without telemetry: what the
+losses were doing, whether the services queue was backing up, whether
+rollbacks or quarantines had started accumulating before the end. This
+module keeps a fixed-size ring of the last K per-step records (step, wall
+and host ms, materialized losses, services queue depth + dropped count,
+gate verdict, rollback/quarantine/compile-cache counters — one
+`CounterRegistry` snapshot per record) and writes it as a standalone JSONL
+dump when the run dies, joining PR 4's stack dumps with the numbers that
+preceded them.
+
+Crash-path-only by construction: recording is an in-memory deque append on
+the dispatch thread; the ONLY file this module ever writes is the dump, and
+the only dump triggers are watchdog trip, NaN abort, coordinated stop, and
+uncaught exceptions — so the default-flags JSONL event stream is untouched
+(the parity contract) even though the recorder is on by default
+(`--flight_recorder_steps`, 0 disables).
+
+Dump format — one JSON object per line:
+
+    {"kind": "flight_recorder", "reason": ..., "time": ..., "step": ...,
+     "process": ..., "records": N, ...context/extra...}   # header
+    {"step": ..., "gate": ..., "step_ms": ..., "metrics": {...},
+     "counters": {...}}                                   # K records,
+                                                          # oldest first
+
+The header carries a partial `perf/startup/*` breakdown when the run died
+before its first step (the StartupProfile satellite — a crash during
+restore/warmup previously lost the phase timings entirely). Writes are
+tmp+rename so a dump that itself crashed mid-write never parses as
+complete, and a dump failure never masks the original error.
+
+Thread contract: `record()` runs on the dispatch thread; `dump()` may run
+on the dispatch thread (exception paths) or the watchdog thread (trip
+path) — the ring is lock-guarded so a trip can snapshot it mid-append.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+
+def recorder_path(checkpoint_dir: str) -> str:
+    """Per-process dump path: the chief owns the bare name; peers suffix
+    their process index so a multi-host crash leaves one dump per host."""
+    import jax
+
+    idx = jax.process_index()
+    name = "flight_recorder.jsonl" if idx == 0 \
+        else f"flight_recorder.p{idx}.jsonl"
+    return os.path.join(checkpoint_dir, name)
+
+
+class FlightRecorder:
+    """Fixed-size ring of per-step telemetry records + crash-path dump."""
+
+    def __init__(self, path: str, *, capacity: int,
+                 context: Optional[Callable[[], dict]] = None):
+        self.path = path
+        self.capacity = capacity
+        self.enabled = capacity > 0 and bool(path)
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(1, capacity))
+        self._lock = threading.Lock()
+        self._context = context
+        self.dumps = 0
+        # free-form context line owned by the trainer (the fleet health
+        # plane parks its slowest-host attribution here so the dump-time
+        # context callable can pick it up); plain str assignment — atomic
+        self.note = ""
+
+    def record(self, rec: dict) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring.append(rec)
+
+    def snapshot(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def dump(self, reason: str, *, step: Optional[int] = None,
+             extra: Optional[dict] = None) -> Optional[str]:
+        """Write the dump; returns its path, or None (disabled, or the
+        write itself failed — the crash path must never raise over the
+        failure it is documenting). Last dump wins the filename: a
+        stop-dump followed by an exception-dump leaves the later, more
+        specific one."""
+        if not self.enabled:
+            return None
+        header = {"kind": "flight_recorder", "reason": reason,
+                  "time": time.time()}
+        if step is not None:
+            header["step"] = int(step)
+        try:
+            ctx = self._context() if self._context is not None else None
+        except Exception:
+            ctx = None
+        header.update(ctx or {})
+        header.update(extra or {})
+        records = self.snapshot()
+        header["records"] = len(records)
+        tmp = self.path + ".tmp"
+        try:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(tmp, "w") as f:
+                f.write(json.dumps(header) + "\n")
+                for rec in records:
+                    f.write(json.dumps(rec) + "\n")
+            os.replace(tmp, self.path)
+        except (OSError, TypeError, ValueError):
+            return None
+        self.dumps += 1
+        return self.path
+
+
+def read_dump(path: str) -> Tuple[dict, List[dict]]:
+    """(header, records) of one dump — the drill/test parse helper."""
+    with open(path) as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    if not lines or lines[0].get("kind") != "flight_recorder":
+        raise ValueError(f"{path} is not a flight-recorder dump")
+    return lines[0], lines[1:]

@@ -36,6 +36,14 @@ sharded device-resident snapshot), a signal on any host becomes a
 whole-job coordinated stop through the collective final save
 (`--coord_stop`), and `--collective_timeout_secs` arms a watchdog that
 turns a hung collective into per-process stack dumps + a nonzero exit.
+
+Observability plane (docs/DESIGN.md §6e): `--profile_trigger` starts an
+on-demand device trace mid-run (digested in-process into `perf/device/*`
+compute/collective/idle-gap attribution), the crash flight recorder
+(`--flight_recorder_steps`) dumps the last K steps of telemetry on every
+dying exit path, `--fleet_health_steps` allgathers a per-host health
+vector into `fleet/*` straggler metrics, and one counter registry
+(utils/metrics.CounterRegistry) feeds all three.
 """
 
 from __future__ import annotations
@@ -65,11 +73,16 @@ from dcgan_tpu.parallel import (
 )
 from dcgan_tpu.testing import chaos
 from dcgan_tpu.train import coordination, warmup
+from dcgan_tpu.train.flight_recorder import FlightRecorder, recorder_path
 from dcgan_tpu.train.rollback import RollbackManager
 from dcgan_tpu.train.services import make_services
 from dcgan_tpu.utils.checkpoint import Checkpointer
 from dcgan_tpu.utils.images import save_sample_grid
-from dcgan_tpu.utils.metrics import MetricWriter, param_histograms
+from dcgan_tpu.utils.metrics import (
+    CounterRegistry,
+    MetricWriter,
+    param_histograms,
+)
 from dcgan_tpu.utils.profiling import StartupProfile, StepTimer, TraceCapture
 
 Pytree = Any
@@ -278,6 +291,20 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
         stop.restore()
 
 
+def _flight_context(startup: StartupProfile, flight: FlightRecorder) -> dict:
+    """Dump-time header context for the crash flight recorder."""
+    out = {"process": jax.process_index()}
+    if not startup.done:
+        # ISSUE 6 satellite: a run that died before its first step ships
+        # the startup phases it DID complete (init/restore/warmup so far)
+        # instead of losing the breakdown with the crash
+        out["startup_partial"] = {k: round(v, 1) for k, v in
+                                  startup.summary().items()}
+    if flight.note:
+        out["fleet_note"] = flight.note
+    return out
+
+
 def _train(cfg: TrainConfig, *, synthetic_data: bool,
            max_steps: Optional[int],
            stop: coordination.CoordinatedStop) -> Pytree:
@@ -289,6 +316,15 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
     # restarts are this trainer's normal response to faults (PRs 3-4), so
     # time-to-first-step is tracked like throughput.
     startup = StartupProfile()
+    # Crash flight recorder (ISSUE 6, DESIGN.md §6e): created before ANY
+    # fallible setup so a death in config validation, restore, or warmup
+    # still dumps (with the partial startup breakdown); the ring fills
+    # once the loop records steps. Crash-path-only IO — nothing is
+    # written unless the run dies.
+    flight = FlightRecorder(
+        recorder_path(cfg.checkpoint_dir),
+        capacity=cfg.flight_recorder_steps,
+        context=lambda: _flight_context(startup, flight))
     cache_dir = warmup.configure_compile_cache(
         warmup.resolve_cache_dir(cfg.compile_cache_dir),
         per_process=cfg.compile_cache_per_process)
@@ -297,7 +333,18 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
     try:
         return _train_run(cfg, synthetic_data=synthetic_data,
                           max_steps=max_steps, stop=stop, startup=startup,
-                          cache_dir=cache_dir, cache_mon=cache_mon)
+                          cache_dir=cache_dir, cache_mon=cache_mon,
+                          flight=flight)
+    except BaseException as e:
+        # every non-returning exit ships the telemetry ring: the NaN
+        # abort keeps its step attribution (the gate stamps e.step), any
+        # other exception records where the loop had gotten to. The dump
+        # is best-effort by contract — it can never mask the error.
+        flight.dump("nan-abort" if isinstance(e, FloatingPointError)
+                    else "exception",
+                    step=getattr(e, "step", None),
+                    extra={"error": repr(e)[:500]})
+        raise
     finally:
         if cache_mon is not None:
             # unregister the monitoring listeners on EVERY exit — config
@@ -311,7 +358,7 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                max_steps: Optional[int],
                stop: coordination.CoordinatedStop, startup: StartupProfile,
                cache_dir: Optional[str],
-               cache_mon) -> Pytree:
+               cache_mon, flight: FlightRecorder) -> Pytree:
     if cfg.fid_every_steps and jax.process_count() > 1 \
             and cfg.fid_num_samples % jax.process_count():
         raise ValueError(
@@ -554,9 +601,6 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
     metrics = {}
     timer = StepTimer(window=cfg.timing_window,
                       images_per_step=cfg.batch_size)
-    trace = TraceCapture(cfg.profile_dir,
-                         start_step=start_step + cfg.profile_start_step,
-                         num_steps=cfg.profile_num_steps)
 
     # Async host services (train/services.py): every non-step host action —
     # metric materialization, param/activation histograms, sample-grid PNG
@@ -573,6 +617,102 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
     svc = make_services(cfg.async_services)
     deferred = cfg.async_services
 
+    # Counter registry (ISSUE 6, utils/metrics.py): ONE typed read surface
+    # over the counters that previously lived in four unrelated places —
+    # the scalar rows' recovery extras, the flight-recorder records, and
+    # the fleet health vector all read the same snapshot, so they can
+    # never drift apart on what "the run's counters" means.
+    registry = CounterRegistry()
+    registry.provide("services_queue", svc.pending)
+    registry.provide("services_dropped",
+                     lambda: int(getattr(svc, "dropped", 0)))
+    registry.provide("corrupt_records",
+                     lambda: quarantine.count() - corrupt_base)
+    if rollback is not None:
+        registry.provide("rollbacks", lambda: rollback.rollbacks)
+    if cache_mon is not None:
+        registry.provide_group(
+            ("compile_cache_requests", "compile_cache_hits",
+             "compile_cache_misses"),
+            lambda: {"compile_cache_" + k: v
+                     for k, v in cache_mon.counters().items()})
+
+    # Trace capture (ISSUE 6): the scheduled window arms only when
+    # --profile_dir was explicitly set (its PR-1 contract); the trigger
+    # file adds ON-DEMAND capture — touch it mid-run, the next boundary
+    # starts a profile_num_steps capture, and the digest below turns the
+    # closed capture into perf/device/* attribution without any offline
+    # tool pass. Trigger-only runs park traces under checkpoint_dir/trace.
+    trace_dir = cfg.profile_dir or (
+        os.path.join(cfg.checkpoint_dir, "trace")
+        if cfg.profile_trigger else "")
+
+    # call sizes (k) dispatched while a capture window was open: the digest
+    # normalizes the busiest program's median by the LARGEST k actually in
+    # the window, not cfg.steps_per_call — a window caught entirely inside
+    # a k=1 realign/tail stretch would otherwise report a step time
+    # steps_per_call x too small
+    capture_ks: list = []
+
+    def _on_trace_capture(stop_step: int) -> None:
+        """A capture just closed: resolve THE file it wrote here on the
+        dispatch thread (one glob — back-to-back captures or shared-dir
+        peers would misattribute a worker-time "newest" lookup), then
+        digest it on the services worker — host-local file IO + parsing
+        only, so the collective-thread rule is untouched. Chief-only:
+        peers capture traces (per-process timelines are themselves useful
+        artifacts) but only the chief materializes events."""
+        ks = capture_ks[:]
+        del capture_ks[:]
+        if not chief:
+            return
+        spc = max(ks) if ks else max(1, cfg.steps_per_call)
+        import socket
+
+        from dcgan_tpu.utils.trace import digest, find_trace
+        try:
+            trace_path = find_trace(trace_dir, host=socket.gethostname())
+        except OSError as e:
+            print(f"[dcgan_tpu] trace capture ending at step {stop_step} "
+                  f"left no trace file: {e!r}", flush=True)
+            return
+
+        def _digest_task(s=stop_step, path=trace_path):
+            d = digest(path)
+            if d["source"] == "none":
+                print(f"[dcgan_tpu] trace capture ending at step {s} has "
+                      "no device events; nothing to digest", flush=True)
+                return
+            row = {
+                "perf/device/compute_ms": d["compute_ms"],
+                "perf/device/collective_ms": d["collective_ms"],
+                "perf/device/idle_gap_ms": d["idle_gap_ms"],
+                "perf/device/span_ms": d["span_ms"],
+                # the device's own per-step time: the busiest program's
+                # median execution, normalized for scanned multi-step
+                # dispatch
+                "perf/device/step_ms": d["program_ms_median"] / spc,
+            }
+            print(f"[dcgan_tpu] trace digest (ending step {s}, "
+                  f"{d['source']} track, top program {d['program']!r} "
+                  f"x{d['program_n']}): "
+                  + " ".join(f"{k.rsplit('/', 1)[1]}={v:.3f}"
+                             for k, v in row.items()), flush=True)
+            writer.write_scalars(s, row)
+        svc.submit(_digest_task, tag="trace-digest")
+
+    trace = TraceCapture(trace_dir,
+                         start_step=start_step + cfg.profile_start_step,
+                         num_steps=cfg.profile_num_steps,
+                         schedule=bool(cfg.profile_dir),
+                         trigger_path=cfg.profile_trigger,
+                         # chief-only removal: peers key off the mtime, so
+                         # a shared-filesystem fleet all captures one touch
+                         # and the digesting process can never lose the
+                         # remove race
+                         consume=chief,
+                         on_capture=_on_trace_capture)
+
     # Hung-collective watchdog (train/coordination.py; off at the default
     # collective_timeout_secs=0): a deadline around each dispatch/consume
     # window, consensus allgather, and collective save. Expiry dumps every
@@ -581,8 +721,13 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
     # one lost peer hang the whole pod forever. The first loop iteration's
     # dispatch is exempt (it compiles); the FID probe and sample/summarize
     # telemetry tails are deliberately unguarded (legitimately long or
-    # droppable — not the collectives that wedge a mesh).
-    watchdog = coordination.make_watchdog(cfg.collective_timeout_secs)
+    # droppable — not the collectives that wedge a mesh). A trip now also
+    # dumps the flight-recorder ring (ISSUE 6) so the stacks arrive with
+    # the telemetry that led up to them.
+    watchdog = coordination.make_watchdog(
+        cfg.collective_timeout_secs,
+        pre_dump=lambda phase, step: flight.dump(
+            "watchdog", step=step, extra={"phase": phase}))
 
     # The watchdog must not arm until the mesh is PROVEN warm: compile
     # time is per-process, so right after THIS process's first dispatch a
@@ -694,16 +839,32 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
     def _health_extras() -> dict:
         """Recovery counters riding the scalar rows — absent until nonzero,
         so default-config event streams are byte-identical to pre-recovery
-        builds (the parity contract)."""
+        builds (the parity contract). Reads the counter registry (ISSUE 6)
+        — the same snapshot the flight recorder and health vector see."""
+        c = registry.snapshot()
         out = {}
-        if rollback is not None and rollback.rollbacks:
-            out["anomaly/rollbacks"] = rollback.rollbacks
-        n_corrupt = quarantine.count() - corrupt_base
-        if n_corrupt:
-            out["data/corrupt_records"] = n_corrupt
+        if c.rollbacks:
+            out["anomaly/rollbacks"] = c.rollbacks
+        if c.corrupt_records:
+            out["data/corrupt_records"] = c.corrupt_records
         return out
 
-    def _nan_gate(p: dict, *, force: bool = False) -> None:
+    def _flight_record(p: dict, gate: str) -> None:
+        """One flight-recorder ring record per consumed step: in-memory
+        deque append + counter reads on the dispatch thread; the losses
+        ride along only when this step's metrics already materialized
+        (the recorder must never force a device readback)."""
+        if not flight.enabled:
+            return
+        host = p.get("host")
+        flight.record({
+            "step": p["step"], "time": time.time(), "gate": gate,
+            "step_ms": timer.last_step_ms, "host_ms": timer.last_host_ms,
+            "metrics": dict(host) if host else None,
+            "counters": registry.snapshot().as_dict(),
+        })
+
+    def _nan_gate(p: dict, *, force: bool = False) -> bool:
         """Numerical-health gate (SURVEY.md §5) with anomaly CONSENSUS
         (ISSUE 4): each process computes a local verdict over its view of
         the replicated metrics, then the verdicts are allgathered so every
@@ -715,11 +876,14 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         invocation; `force` (the rollback manager certifying a snapshot
         candidate off-cadence) is step-keyed too. testing/chaos.py can
         poison THIS process's view of the metrics (once) to drill the
-        consensus path without real divergence."""
+        consensus path without real divergence. Returns whether the gate
+        EVALUATED (False = off-cadence skip) — the flight recorder's
+        gate-verdict column reads this instead of re-deriving the cadence,
+        so the two can never disagree."""
         s = p["step"]
         if not force and not (cfg.nan_check_steps
                               and s % cfg.nan_check_steps == 0):
-            return
+            return False
         vals = dict(_host_vals(p))
         if chaos.should_inject_nan(s):
             vals["d_loss"] = float("nan")
@@ -735,6 +899,7 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 f"{cfg.checkpoint_dir}")
             err.step = s
             raise err
+        return True
 
     def _consume_metrics(p: dict) -> None:
         """Host-side consumers of one step's replicated metric scalars:
@@ -747,13 +912,23 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         number, one step later. All cadence math uses the record's own
         step, so attribution is identical in both modes."""
         s = p["step"]
-        _nan_gate(p)
+        try:
+            gated = _nan_gate(p)
+        except FloatingPointError:
+            # the failing step must be the ring's LAST record — the
+            # acceptance contract a dump reader leans on
+            _flight_record(p, "trip")
+            raise
         if chief and cfg.log_every_steps and s % cfg.log_every_steps == 0:
             m = _host_vals(p)
             epoch = s * cfg.batch_size // epoch_size
             print(f"[dcgan_tpu] epoch {epoch} step {s} "
                   f"time {time.time() - t_start:.1f}s "
                   f"d_loss {m['d_loss']:.4f} g_loss {m['g_loss']:.4f}")
+        # record AFTER the step log so the ring rides the materialization
+        # the log already paid for (default chief logs every step); still
+        # never forces a readback of its own
+        _flight_record(p, "ok" if gated else "")
         if p["write_scalars"]:
             row = {**_host_vals(p), **timer.summary(), **_health_extras()}
             svc.submit(lambda: writer.write_scalars(s, row), tag="scalars")
@@ -891,6 +1066,11 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                         if n_proc > 1 else ""
                     print(f"[dcgan_tpu] received signal {stop_sig}{where} "
                           f"— checkpointing at step {step_num} and exiting")
+                # preemption post-mortem context (ISSUE 6): the telemetry
+                # that led into the stop, stamped with the step being
+                # saved — crash-path-only IO, so parity holds
+                flight.dump("coordinated-stop", step=step_num,
+                            extra={"signal": int(stop_sig)})
                 # drain the services queue BEFORE the final save below: the
                 # emergency checkpoint must not outrun queued JSONL/TB
                 # events, or a post-stop inspection sees a stream truncated
@@ -918,6 +1098,8 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 watchdog.arm("step-dispatch", step_num)
             chaos.maybe_hang(step_num)  # drill: a peer that goes silent
             trace.maybe_start(step_num)
+            if trace.active:
+                capture_ks.append(k)  # this boundary is inside the window
             labels = None
             if k == 1:
                 key = jax.random.fold_in(base_key, step_num)
@@ -993,6 +1175,34 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
             if deferred:
                 pending = cur
             watchdog.disarm()  # dispatch/consume window completed
+
+            # Fleet health plane (ISSUE 6): one compact float32 allgather
+            # per cadence, issued HERE on the dispatch thread (collective-
+            # thread rule — a background-thread collective would interleave
+            # nondeterministically against step dispatches and wedge the
+            # mesh). Every process contributes its HEALTH_FIELDS vector;
+            # the chief materializes fleet/* (straggler skew, slowest
+            # host, queue/drop/recovery totals) and the slowest-host line
+            # is parked on the watchdog + flight recorder so a later trip
+            # names the likely wedged peer.
+            if cfg.fleet_health_steps and \
+                    new_step % cfg.fleet_health_steps == 0:
+                tsum = timer.summary()
+                c = registry.snapshot()
+                vec = np.asarray(
+                    [new_step, tsum.get("perf/step_ms_mean", 0.0),
+                     tsum.get("perf/host_ms_mean", 0.0), c.services_queue,
+                     c.services_dropped, c.rollbacks, c.corrupt_records],
+                    np.float32)
+                with _guard("fleet-health", new_step):
+                    table = coordination.fleet_health_gather(vec)
+                frow, fleet_note = coordination.fleet_metrics(table)
+                watchdog.set_note(fleet_note)
+                flight.note = fleet_note
+                if chief:
+                    svc.submit(lambda s=new_step, r=frow:
+                               writer.write_scalars(s, r),
+                               tag="fleet-health")
 
             # per-layer activation histograms + sparsity (the reference's
             # _activation_summary channel, distriubted_model.py:75-80). The

@@ -11,12 +11,83 @@ utils/images.py.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import time
-from typing import Any, Dict, Mapping, Optional
+from typing import Any, Callable, Dict, Mapping, Optional
 
 import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class CounterSnapshot:
+    """One coherent read of the run's recovery/perf counters (ISSUE 6).
+
+    Before this registry the counters lived in four unrelated places —
+    `HostServices.dropped`, the process-global quarantine tally, the
+    rollback manager's count, and `CompileCacheMonitor` — and each consumer
+    (the scalar rows' `_health_extras`, now also the flight recorder and
+    the fleet health vector) re-derived its own subset. A snapshot is the
+    single read surface; fields a run never wires stay 0.
+    """
+
+    services_queue: int = 0        # tasks pending on the services worker
+    services_dropped: int = 0      # tasks discarded by backpressure
+    rollbacks: int = 0             # NaN-gate rollbacks this run
+    corrupt_records: int = 0       # quarantined records this run (delta
+                                   # from the trainer's corrupt_base)
+    compile_cache_requests: int = 0
+    compile_cache_hits: int = 0
+    compile_cache_misses: int = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        # flat getattr walk, not dataclasses.asdict: asdict deep-copies
+        # recursively, and this runs once per consumed step on the dispatch
+        # thread (the flight recorder is on by default)
+        return {name: getattr(self, name) for name in _SNAPSHOT_FIELD_ORDER}
+
+
+_SNAPSHOT_FIELD_ORDER = tuple(f.name for f in
+                              dataclasses.fields(CounterSnapshot))
+_SNAPSHOT_FIELDS = frozenset(_SNAPSHOT_FIELD_ORDER)
+
+
+class CounterRegistry:
+    """Named providers -> CounterSnapshot; the trainer registers each
+    subsystem's live counter once and every consumer reads `snapshot()`."""
+
+    def __init__(self) -> None:
+        self._providers: Dict[str, Callable[[], int]] = {}
+        self._groups: list = []
+
+    def provide(self, field: str, fn: Callable[[], int]) -> None:
+        if field not in _SNAPSHOT_FIELDS:
+            raise ValueError(
+                f"unknown counter {field!r}; CounterSnapshot fields: "
+                f"{sorted(_SNAPSHOT_FIELDS)}")
+        self._providers[field] = fn
+
+    def provide_group(self, fields, fn: Callable[[], Mapping[str, Any]]
+                      ) -> None:
+        """One provider feeding several fields from a single read — for
+        sources whose counters come as one dict (CompileCacheMonitor):
+        snapshot() calls `fn` once, not once per field. `fn` may return
+        extra keys; only `fields` are consumed."""
+        for field in fields:
+            if field not in _SNAPSHOT_FIELDS:
+                raise ValueError(
+                    f"unknown counter {field!r}; CounterSnapshot fields: "
+                    f"{sorted(_SNAPSHOT_FIELDS)}")
+        self._groups.append((tuple(fields), fn))
+
+    def snapshot(self) -> CounterSnapshot:
+        vals = {name: int(fn()) for name, fn in self._providers.items()}
+        for fields, fn in self._groups:
+            got = fn()
+            for field in fields:
+                vals[field] = int(got[field])
+        return CounterSnapshot(**vals)
 
 
 def histogram_summary(values, bins: int = 30) -> Dict[str, Any]:

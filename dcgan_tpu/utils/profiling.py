@@ -11,7 +11,11 @@ timing" — and this module is it:
   MetricWriter alongside the loss scalars.
 - `TraceCapture`: captures a jax.profiler trace (XLA device + host timelines,
   viewable in TensorBoard/Perfetto) for a configured window of steps, e.g.
-  steps [10, 15) once compilation has settled.
+  steps [10, 15) once compilation has settled — or ON DEMAND (ISSUE 6):
+  with a trigger path configured, touching that file starts a capture of
+  the next `num_steps` steps mid-run, no restart or pre-chosen
+  --profile_start_step needed; each completed capture fires `on_capture`
+  so the trainer can digest it in-process (utils/trace.py).
 
 Timing caveat: step dispatch is async; host-side wall time per step is only
 meaningful when something syncs the host to the device each iteration. The
@@ -37,8 +41,9 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import os
 import time
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 
 class StepTimer:
@@ -70,6 +75,17 @@ class StepTimer:
         """Accumulate dispatch-thread host-work time attributed to the
         steps of the NEXT tick (call any number of times per iteration)."""
         self._host_pending += seconds
+
+    @property
+    def last_step_ms(self):
+        """Most recent per-step wall ms (None before the second tick) —
+        the flight recorder's per-record step time."""
+        return 1e3 * self._durations[-1] if self._durations else None
+
+    @property
+    def last_host_ms(self):
+        """Most recent per-step dispatch-thread host-work ms."""
+        return 1e3 * self._host[-1] if self._host else None
 
     def __len__(self) -> int:
         return len(self._durations)
@@ -145,35 +161,99 @@ class StartupProfile:
 
 
 class TraceCapture:
-    """One-shot jax.profiler capture over steps [start_step, start_step+num).
+    """jax.profiler capture windows: one scheduled, any number triggered.
 
     Call maybe_start(step) before dispatching the step and maybe_stop(step)
-    after it; the capture brackets exactly `num_steps` steps. Inactive (and
-    free) when logdir is empty.
+    after it; each capture brackets exactly `num_steps` steps. Two ways a
+    window opens (ISSUE 6):
+
+    - scheduled (the PR-1 behavior): with `schedule=True` and a logdir, one
+      one-shot capture starts at the first boundary >= start_step;
+    - triggered: with `trigger_path` set, touching that file starts a
+      capture at the next boundary (one touch, one capture; touch again
+      for another). The poll is one os.stat per boundary, and only when a
+      trigger path is configured, so default runs pay nothing.
+
+    Trigger consumption is mtime-keyed, not remove-keyed: each process
+    captures when it sees a NEW mtime and remembers it, and only the
+    `consume` process (the trainer passes the chief) deletes the file —
+    at the END of its capture, not the start. Multi-process jobs sharing
+    a filesystem would otherwise race: an at-start remove wins on
+    whichever boundary stats first, and every later-polling peer
+    (possibly the chief, the only process that digests) silently did
+    nothing. Deferring removal to capture-end leaves the file visible for
+    the full num_steps window — SPMD hosts run boundaries in near-
+    lockstep, so every peer's poll lands inside it. One mtime serves one
+    capture per process (a touch DURING a capture is absorbed by the
+    removal at its end), and an undeletable file degrades to
+    once-per-touch instead of a capture loop.
+
+    `on_capture(stop_step)` fires after each capture closes — the trainer
+    hands the trace to the services worker for in-process digestion.
+    Inactive (and free) when logdir is empty.
     """
 
     def __init__(self, logdir: str, *, start_step: int = 10,
-                 num_steps: int = 5):
+                 num_steps: int = 5, schedule: bool = True,
+                 trigger_path: str = "", consume: bool = True,
+                 on_capture: Optional[Callable[[int], None]] = None):
         self.logdir = logdir
         self.start_step = start_step
-        self.stop_step = start_step + num_steps
+        self.num_steps = num_steps
+        self.trigger_path = trigger_path if logdir else ""
+        self.consume = consume
+        self.on_capture = on_capture
         self._active = False
-        self._done = not logdir or num_steps <= 0
+        self._scheduled_done = not (schedule and logdir and num_steps > 0)
+        self._stop_at = 0
+        self._served_mtime: Optional[int] = None
+        self._consume_pending = False
+        self.captures = 0
 
-    def maybe_start(self, step: int) -> None:
-        if self._done or self._active or step < self.start_step:
-            return
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def _begin(self, step: int) -> None:
         import jax
 
         jax.profiler.start_trace(self.logdir)
         self._active = True
+        self._stop_at = step + self.num_steps
+
+    def maybe_start(self, step: int) -> None:
+        if self._active:
+            return
+        if not self._scheduled_done and step >= self.start_step:
+            self._scheduled_done = True
+            self._begin(step)
+            return
+        if self.trigger_path and self.num_steps > 0:
+            try:
+                mtime = os.stat(self.trigger_path).st_mtime_ns
+            except OSError:
+                return  # absent (or unreadable): nothing to serve
+            if mtime == self._served_mtime:
+                return  # this touch already got its capture
+            self._served_mtime = mtime
+            self._consume_pending = self.consume
+            self._begin(step)
+
+    def _consume_trigger(self) -> None:
+        if not self._consume_pending:
+            return
+        self._consume_pending = False
+        try:
+            os.remove(self.trigger_path)
+        except OSError:
+            pass  # mtime guard prevents a re-trigger loop
 
     def maybe_stop(self, step: int, sync=None) -> None:
         """`step` is the number of steps completed so far; pass the step's
         outputs as `sync` so the trace contains the device execution, not just
         its dispatch (the train step is pure, so only blocking on its results
         guarantees completion)."""
-        if not self._active or step < self.stop_step:
+        if not self._active or step < self._stop_at:
             return
         import jax
 
@@ -181,7 +261,10 @@ class TraceCapture:
             jax.block_until_ready(sync)
         jax.profiler.stop_trace()
         self._active = False
-        self._done = True
+        self.captures += 1
+        self._consume_trigger()
+        if self.on_capture is not None:
+            self.on_capture(step)
 
     def close(self) -> None:
         if self._active:
@@ -189,4 +272,4 @@ class TraceCapture:
 
             jax.profiler.stop_trace()
             self._active = False
-            self._done = True
+            self._consume_trigger()

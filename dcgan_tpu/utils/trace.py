@@ -1,0 +1,236 @@
+"""Shared jax.profiler trace parsing: device-track selection, per-program
+rows, and the step-time digest (ISSUE 6 tentpole).
+
+`tools/trace_summary.py` owned the only Chrome-trace parser; promoting it
+here lets the trainer digest a capture IN-PROCESS (on the services worker)
+the moment a trigger-file capture closes, instead of requiring an offline
+tool pass — the `perf/device/*` attribution ROADMAP item 3 needs (where a
+step's time actually goes on the device: compute, collectives, and the
+idle gaps between consecutive dispatches that an overlapped G/D pipeline
+would fill).
+
+Track selection, in preference order:
+
+- pids whose process_name contains "TPU" (e.g. ``/device:TPU:0``) — real
+  device timelines. NOT "the busiest pid": on a v5e capture the host pid's
+  total X-duration exceeds the device's (host spans nest), so a naive
+  busiest-pid rule would pick the host. Within a TPU pid, program-level
+  accounting reads the ``XLA Modules`` thread (per-program executions) —
+  the ``Steps`` thread's spans cover the whole timeline (they would report
+  zero idle) and the ``XLA Ops`` thread is per-op; ops are consulted only
+  for collective attribution (collectives are op-named, not module-named).
+- else the busiest XLA-executor THREAD track (thread_name matching
+  ``XLA``, e.g. ``tf_XLATfrtCpuClient/...``) — where CPU captures put op
+  execution. Thread granularity matters: the CPU ``python`` thread carries
+  whole-call tracing spans (PjitFunction, profiler frames) that cover the
+  timeline and would report zero idle.
+- else the busiest non-``python`` thread track of any pid.
+- else: no device events (`source == "none"`); callers decide (the CLI
+  tool exits nonzero with a usage hint — a silent empty report looked like
+  a healthy parse, satellite fix).
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+from typing import Any, Dict, List, Tuple
+
+# substrings marking a device-side collective in XLA program/op names
+_COLLECTIVE_MARKERS = ("all-reduce", "all-gather", "reduce-scatter",
+                      "all-to-all", "collective-permute", "collective",
+                      "allreduce", "allgather", "ragged-all-to-all")
+
+
+def find_trace(path: str, host: str = "") -> str:
+    """Accept a trace file or a --profile_dir root (finds the newest).
+
+    With `host`, hits whose filename belongs to that host win: the
+    profiler names each process's file `<hostname>.trace.json.gz` inside
+    a shared timestamped session dir, so on a shared-filesystem fleet the
+    plain lexicographic tail could be a PEER's timeline. Falls back to
+    the newest hit when no filename matches (single-machine multi-process
+    captures share one hostname; old layouts may differ)."""
+    if os.path.isfile(path):
+        return path
+    hits = sorted(glob.glob(os.path.join(
+        path, "**", "*.trace.json.gz"), recursive=True))
+    if not hits:
+        raise FileNotFoundError(f"no *.trace.json.gz under {path}")
+    if host:
+        mine = [h for h in hits
+                if os.path.basename(h).startswith(host + ".")]
+        if mine:
+            return mine[-1]
+    return hits[-1]
+
+
+def load_events(trace_path: str) -> List[dict]:
+    """The raw traceEvents list of one capture (gz or plain json)."""
+    opener = gzip.open if trace_path.endswith(".gz") else open
+    with opener(trace_path) as f:
+        data = json.load(f)
+    return data.get("traceEvents", [])
+
+
+def _meta_names(events: List[dict], kind: str) -> Dict[Any, str]:
+    """{pid or (pid, tid): name} from 'process_name'/'thread_name' rows."""
+    out: Dict[Any, str] = {}
+    for e in events:
+        if e.get("ph") != "M" or e.get("name") != kind:
+            continue
+        name = str(e.get("args", {}).get("name", ""))
+        key = e["pid"] if kind == "process_name" \
+            else (e["pid"], e.get("tid"))
+        out[key] = name
+    return out
+
+
+def select_device_tracks(events: List[dict]
+                         ) -> Tuple[List[dict], List[dict], str]:
+    """(program events, op events, source) of the device timeline.
+
+    `programs` carries per-program execution spans (busy/idle/step-time
+    accounting); `ops` carries the finer per-op spans when the capture has
+    them (collective attribution — collectives are op-named). Source is
+    "tpu" (TPU-named pid), "xla-thread" / "busiest-thread" (CPU-capture
+    fallbacks; programs == ops there), or "none"."""
+    xs = [e for e in events if e.get("ph") == "X" and "dur" in e]
+    if not xs:
+        return [], [], "none"
+    pnames = _meta_names(events, "process_name")
+    tnames = _meta_names(events, "thread_name")
+
+    def tname(e):
+        return tnames.get((e["pid"], e.get("tid")), "")
+
+    tpu_pids = {pid for pid, name in pnames.items() if "TPU" in name}
+    if tpu_pids:
+        dev = [e for e in xs if e["pid"] in tpu_pids]
+        programs = [e for e in dev if "XLA Modules" in tname(e)]
+        if not programs:
+            # module track absent (older capture layout): everything but
+            # the whole-timeline "Steps" spans
+            programs = [e for e in dev if "Steps" not in tname(e)] or dev
+        ops = [e for e in dev if "XLA Ops" in tname(e)] or programs
+        return programs, ops, "tpu"
+    by_track: Dict[Tuple[Any, Any], float] = {}
+    for e in xs:
+        key = (e["pid"], e.get("tid"))
+        by_track[key] = by_track.get(key, 0.0) + e["dur"]
+
+    def busiest(keys):
+        return max(keys, key=lambda k: by_track[k], default=None)
+
+    xla = busiest([k for k in by_track if "XLA" in tnames.get(k, "")])
+    if xla is not None:
+        track, source = xla, "xla-thread"
+    else:
+        track = busiest([k for k in by_track
+                         if "python" not in tnames.get(k, "").lower()]) \
+            or busiest(by_track)
+        source = "busiest-thread"
+    picked = [e for e in xs if (e["pid"], e.get("tid")) == track]
+    return picked, picked, source
+
+
+def program_rows(device_events: List[dict]) -> List[dict]:
+    """Per-program execution stats, sorted by total time descending —
+    the rows tools/trace_summary.py prints."""
+    rows: Dict[str, List[float]] = {}
+    for e in device_events:
+        rows.setdefault(e["name"], []).append(e["dur"] / 1e3)  # us -> ms
+    out = []
+    for name, durs in sorted(rows.items(), key=lambda kv: -sum(kv[1])):
+        ds = sorted(durs)
+        out.append({
+            "program": name[:80], "n": len(ds),
+            "total_ms": round(sum(ds), 3),
+            "ms_min": round(ds[0], 4), "ms_max": round(ds[-1], 4),
+            "ms_median": round(ds[len(ds) // 2], 4),
+        })
+    return out
+
+
+def summarize(trace_path: str) -> Tuple[List[dict], str]:
+    """(per-program rows, track source) for one capture."""
+    programs, _, source = select_device_tracks(load_events(trace_path))
+    return program_rows(programs), source
+
+
+def _merge_intervals(spans: List[Tuple[float, float]]
+                     ) -> List[Tuple[float, float]]:
+    merged: List[List[float]] = []
+    for lo, hi in sorted(spans):
+        if merged and lo <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], hi)
+        else:
+            merged.append([lo, hi])
+    return [(lo, hi) for lo, hi in merged]
+
+
+def is_collective(name: str) -> bool:
+    low = name.lower()
+    return any(m in low for m in _COLLECTIVE_MARKERS)
+
+
+def devstep_ms(path: str, per_exec: int = 1):
+    """The device's own per-step ms from a capture (file or profile dir):
+    the busiest program's median execution divided by `per_exec` (the
+    steps each execution covers — a scanned multi-step program's scan
+    width). None when the capture has no usable device events — callers
+    (the BENCH rows) publish the field as null rather than fabricating.
+    One definition shared by bench.py, tools/bench_trainer_loop.py, and
+    the trainer's live perf/device/step_ms so the three can't drift."""
+    d = digest(find_trace(path))
+    if d["source"] == "none" or d["program_ms_median"] <= 0:
+        return None
+    return d["program_ms_median"] / max(1, per_exec)
+
+
+def digest(trace_path: str) -> dict:
+    """Step-time attribution over one capture's device timeline.
+
+    Returns (all ms):
+      - source:        which track selection applied (see module doc)
+      - compute_ms:    union of device busy time (overlapping spans merged,
+                       so nested/async events are not double counted)
+      - collective_ms: busy time of collective-named events
+      - idle_gap_ms:   span minus busy — the time the device sat between
+                       consecutive dispatches. THE number ROADMAP item 3
+                       (overlapped G/D execution) needs to attribute
+                       honestly: a pipelined schedule's win is bounded by
+                       this gap.
+      - span_ms:       first event start -> last event end
+      - program / program_n / program_ms_median: the busiest program (on a
+        real device timeline: the train step program; callers divide its
+        median by steps_per_call for a per-step devstep_ms)
+      - rows:          the full per-program table
+    """
+    programs, ops, source = select_device_tracks(load_events(trace_path))
+    if not programs:
+        return {"source": "none", "compute_ms": 0.0, "collective_ms": 0.0,
+                "idle_gap_ms": 0.0, "span_ms": 0.0, "program": "",
+                "program_n": 0, "program_ms_median": 0.0, "rows": []}
+    spans = [(e["ts"], e["ts"] + e["dur"]) for e in programs]
+    merged = _merge_intervals(spans)
+    busy_us = sum(hi - lo for lo, hi in merged)
+    span_us = merged[-1][1] - merged[0][0]
+    coll = [(e["ts"], e["ts"] + e["dur"])
+            for e in ops if is_collective(e["name"])]
+    coll_us = sum(hi - lo for lo, hi in _merge_intervals(coll))
+    rows = program_rows(programs)
+    top = rows[0]
+    return {
+        "source": source,
+        "compute_ms": round(busy_us / 1e3, 4),
+        "collective_ms": round(coll_us / 1e3, 4),
+        "idle_gap_ms": round(max(0.0, span_us - busy_us) / 1e3, 4),
+        "span_ms": round(span_us / 1e3, 4),
+        "program": top["program"],
+        "program_n": top["n"],
+        "program_ms_median": top["ms_median"],
+        "rows": rows,
+    }

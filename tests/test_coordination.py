@@ -262,6 +262,71 @@ class TestDeviceResidentRollback:
             mgr.restore(FloatingPointError("two"))
 
 
+class TestFleetHealth:
+    """ISSUE 6 fleet health plane: the allgathered per-host vector and the
+    fleet/* derivation, with the same fake-transport shims as the rest of
+    this file (the end-to-end single-process path is
+    tests/test_flight_recorder.py::TestFleetHealthEndToEnd)."""
+
+    def _vec(self, step, step_ms, host_ms=1.0, queue=0, dropped=0,
+             rollbacks=0, corrupt=0):
+        return np.asarray([step, step_ms, host_ms, queue, dropped,
+                           rollbacks, corrupt], np.float32)
+
+    def test_single_process_gather_is_local_table(self):
+        table = coordination.fleet_health_gather(self._vec(4, 12.5))
+        assert table.shape == (1, len(coordination.HEALTH_FIELDS))
+        assert table[0, 1] == pytest.approx(12.5)
+
+    def test_multihost_gather_uses_the_f32_transport(self, monkeypatch):
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        sent = []
+
+        def capture(vec):
+            sent.append(np.asarray(vec))
+            return np.stack([np.asarray(vec), np.asarray(vec) * 2])
+
+        monkeypatch.setattr(coordination, "_allgather_f32", capture)
+        table = coordination.fleet_health_gather(self._vec(4, 10.0))
+        assert sent and sent[0].shape == (7,)   # local vector on the wire
+        assert table.shape == (2, 7)
+        assert table[1, 1] == pytest.approx(20.0)
+
+    def test_fleet_metrics_skew_and_slowest_host(self):
+        table = np.stack([self._vec(6, 10.0, host_ms=2.0, dropped=1),
+                          self._vec(6, 35.0, host_ms=3.0, queue=4),
+                          self._vec(6, 12.0, rollbacks=1, corrupt=2)])
+        row, note = coordination.fleet_metrics(table)
+        assert row["fleet/step_ms_max"] == pytest.approx(35.0)
+        assert row["fleet/step_ms_min"] == pytest.approx(10.0)
+        assert row["fleet/step_ms_skew"] == pytest.approx(25.0)
+        assert row["fleet/slowest_host"] == 1.0
+        assert row["fleet/queue_depth_max"] == 4.0
+        assert row["fleet/dropped_total"] == 1.0
+        assert row["fleet/rollbacks_total"] == 1.0
+        assert row["fleet/corrupt_total"] == 2.0
+        assert "process 1" in note and "35.0" in note
+
+    def test_trip_header_names_the_slowest_host(self, capfd, monkeypatch):
+        """The watchdog note (set at each health gather) must surface in
+        the trip header — the operator's first straggler attribution.
+        capfd, not capsys: faulthandler writes to the real fd."""
+        import os as os_mod
+
+        wd = coordination.CollectiveWatchdog(0.1, poll_interval=0.02,
+                                             on_trip=lambda *a: None)
+        try:
+            wd.set_note("slowest host: process 3 (step_ms_mean 99.0 vs "
+                        "fleet min 10.0)")
+            monkeypatch.setattr(os_mod, "_exit", lambda code: None)
+            wd._dump_and_exit("step-dispatch", 7)
+            err = capfd.readouterr().err
+            assert "slowest host: process 3" in err
+            assert "step-dispatch" in err
+        finally:
+            wd.close()
+
+
 class TestNewKnobs:
     def test_config_validation(self):
         from dcgan_tpu.config import TrainConfig
@@ -270,6 +335,35 @@ class TestNewKnobs:
         assert TrainConfig().collective_timeout_secs == 0.0
         with pytest.raises(ValueError, match="collective_timeout_secs"):
             TrainConfig(collective_timeout_secs=-1.0)
+
+    def test_observability_knob_validation(self):
+        from dcgan_tpu.config import TrainConfig
+
+        cfg = TrainConfig()
+        assert cfg.fleet_health_steps == 0      # off: parity default
+        assert cfg.flight_recorder_steps == 64  # on, crash-path-only IO
+        assert cfg.profile_trigger == ""
+        with pytest.raises(ValueError, match="fleet_health_steps"):
+            TrainConfig(fleet_health_steps=-1)
+        with pytest.raises(ValueError, match="flight_recorder_steps"):
+            TrainConfig(flight_recorder_steps=-2)
+        # the health gather is a collective: it joins the steps_per_call
+        # cadence-alignment rule
+        with pytest.raises(ValueError, match="fleet_health_steps"):
+            TrainConfig(steps_per_call=4, sample_every_steps=4,
+                        nan_check_steps=4, activation_summary_steps=4,
+                        save_model_steps=4, log_every_steps=4,
+                        fleet_health_steps=3)
+
+    def test_observability_flags_reach_config(self):
+        from dcgan_tpu.train.cli import build_parser, config_from_args
+
+        cfg = config_from_args(build_parser().parse_args(
+            ["--profile_trigger", "/tmp/t", "--flight_recorder_steps",
+             "32", "--fleet_health_steps", "50"]))
+        assert cfg.profile_trigger == "/tmp/t"
+        assert cfg.flight_recorder_steps == 32
+        assert cfg.fleet_health_steps == 50
 
     def test_flags_reach_config(self):
         from dcgan_tpu.train.cli import build_parser, config_from_args

@@ -1,0 +1,335 @@
+"""Crash flight recorder + counter registry + observability-plane trainer
+wiring (ISSUE 6): ring semantics, every dump trigger (watchdog / NaN abort
+/ coordinated stop / uncaught exception, driven by FaultPlan), the
+startup-partial satellite, and the defaults-parity A/B."""
+
+import json
+import os
+
+import jax
+import pytest
+
+from dcgan_tpu.testing import chaos
+from dcgan_tpu.train import coordination
+from dcgan_tpu.train.flight_recorder import (
+    FlightRecorder,
+    read_dump,
+    recorder_path,
+)
+from dcgan_tpu.utils.metrics import CounterRegistry, CounterSnapshot
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos_state():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+class TestRing:
+    def test_capacity_bounds_and_order(self, tmp_path):
+        fr = FlightRecorder(str(tmp_path / "d.jsonl"), capacity=3)
+        for i in range(7):
+            fr.record({"step": i})
+        assert [r["step"] for r in fr.snapshot()] == [4, 5, 6]
+        assert len(fr) == 3
+
+    def test_zero_capacity_disables_everything(self, tmp_path):
+        fr = FlightRecorder(str(tmp_path / "d.jsonl"), capacity=0)
+        fr.record({"step": 1})
+        assert not fr.enabled and len(fr) == 0
+        assert fr.dump("exception") is None
+        assert not os.path.exists(str(tmp_path / "d.jsonl"))
+
+    def test_dump_roundtrip(self, tmp_path):
+        path = str(tmp_path / "sub" / "d.jsonl")  # dir created on demand
+        fr = FlightRecorder(path, capacity=4)
+        for i in range(6):
+            fr.record({"step": i, "gate": ""})
+        out = fr.dump("nan-abort", step=5, extra={"error": "boom"})
+        assert out == path and fr.dumps == 1
+        header, records = read_dump(path)
+        assert header["reason"] == "nan-abort" and header["step"] == 5
+        assert header["error"] == "boom" and header["records"] == 4
+        assert [r["step"] for r in records] == [2, 3, 4, 5]
+
+    def test_context_supplied_and_fail_safe(self, tmp_path):
+        calls = []
+
+        def ctx():
+            calls.append(1)
+            if len(calls) == 1:
+                return {"process": 7, "startup_partial": {"x_ms": 1.0}}
+            raise RuntimeError("context exploded")
+
+        fr = FlightRecorder(str(tmp_path / "d.jsonl"), capacity=2,
+                            context=ctx)
+        fr.dump("watchdog", step=3)
+        header, _ = read_dump(str(tmp_path / "d.jsonl"))
+        assert header["process"] == 7 and header["startup_partial"]
+        # a raising context must not kill the crash path
+        assert fr.dump("watchdog", step=4) is not None
+
+    def test_last_dump_wins(self, tmp_path):
+        fr = FlightRecorder(str(tmp_path / "d.jsonl"), capacity=2)
+        fr.dump("coordinated-stop", step=1)
+        fr.dump("exception", step=2)
+        header, _ = read_dump(str(tmp_path / "d.jsonl"))
+        assert header["reason"] == "exception" and fr.dumps == 2
+
+    def test_read_dump_rejects_non_dumps(self, tmp_path):
+        p = tmp_path / "x.jsonl"
+        p.write_text('{"kind": "scalars"}\n')
+        with pytest.raises(ValueError, match="not a flight-recorder"):
+            read_dump(str(p))
+
+    def test_recorder_path_is_per_process(self, monkeypatch):
+        assert recorder_path("/ck").endswith("/ck/flight_recorder.jsonl")
+        monkeypatch.setattr(jax, "process_index", lambda: 2)
+        assert recorder_path("/ck").endswith("flight_recorder.p2.jsonl")
+
+
+class TestCounterRegistry:
+    def test_snapshot_pulls_registered_providers(self):
+        reg = CounterRegistry()
+        reg.provide("services_dropped", lambda: 3)
+        reg.provide("rollbacks", lambda: 1)
+        snap = reg.snapshot()
+        assert snap.services_dropped == 3 and snap.rollbacks == 1
+        assert snap.corrupt_records == 0  # unwired field stays default
+        assert snap.as_dict()["services_dropped"] == 3
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown counter"):
+            CounterRegistry().provide("nope", lambda: 0)
+        with pytest.raises(ValueError, match="unknown counter"):
+            CounterRegistry().provide_group(("rollbacks", "nope"),
+                                            lambda: {})
+
+    def test_group_provider_reads_source_once_per_snapshot(self):
+        """provide_group exists so one counters() dict feeds several
+        fields (CompileCacheMonitor): snapshot() must call it once, and
+        extra keys in the returned mapping are ignored."""
+        calls = []
+
+        def src():
+            calls.append(1)
+            return {"compile_cache_requests": 5, "compile_cache_hits": 4,
+                    "compile_cache_misses": 1, "saved_ms": 12.5}
+
+        reg = CounterRegistry()
+        reg.provide_group(("compile_cache_requests", "compile_cache_hits",
+                           "compile_cache_misses"), src)
+        snap = reg.snapshot()
+        assert len(calls) == 1
+        assert (snap.compile_cache_requests, snap.compile_cache_hits,
+                snap.compile_cache_misses) == (5, 4, 1)
+
+    def test_snapshot_is_frozen(self):
+        snap = CounterSnapshot()
+        with pytest.raises(Exception):
+            snap.rollbacks = 5
+
+
+class TestWatchdogDumpHook:
+    def test_pre_dump_fires_before_on_trip(self):
+        order = []
+        wd = coordination.CollectiveWatchdog(
+            0.1, poll_interval=0.02,
+            pre_dump=lambda phase, step: order.append(("dump", phase, step)),
+            on_trip=lambda phase, step: order.append(("trip", phase, step)))
+        try:
+            wd.arm("collective-save", 9)
+            import time
+            t0 = time.monotonic()
+            while not order and time.monotonic() - t0 < 2.0:
+                time.sleep(0.02)
+            assert order[:2] == [("dump", "collective-save", 9),
+                                 ("trip", "collective-save", 9)]
+        finally:
+            wd.close()
+
+    def test_raising_pre_dump_does_not_block_the_trip(self):
+        trips = []
+
+        def bad_dump(phase, step):
+            raise OSError("disk gone")
+
+        wd = coordination.CollectiveWatchdog(
+            0.1, poll_interval=0.02, pre_dump=bad_dump,
+            on_trip=lambda phase, step: trips.append(step))
+        try:
+            wd.arm("final-save", 4)
+            import time
+            t0 = time.monotonic()
+            while not trips and time.monotonic() - t0 < 2.0:
+                time.sleep(0.02)
+            assert trips == [4]
+        finally:
+            wd.close()
+
+    def test_note_lands_in_null_watchdog_too(self):
+        wd = coordination.make_watchdog(0.0)
+        wd.set_note("slowest host: process 1")  # free no-op
+
+
+def _tiny_cfg(tmp_path, **kw):
+    from dcgan_tpu.config import ModelConfig, TrainConfig
+
+    base = dict(
+        model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                          compute_dtype="float32"),
+        batch_size=16,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        sample_dir=str(tmp_path / "samples"),
+        sample_every_steps=0, save_summaries_secs=0.0, save_model_secs=1e9,
+        log_every_steps=0, tensorboard=False)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _scalar_rows(root):
+    rows = []
+    with open(os.path.join(root, "ckpt", "events.jsonl")) as f:
+        for line in f:
+            e = json.loads(line)
+            if e["kind"] == "scalars":
+                rows.append((e["step"], e["values"]))
+    return rows
+
+
+@pytest.mark.slow
+class TestTrainerDumpTriggers:
+    """Each dying exit path of the real trainer ships the ring, driven by
+    FaultPlan (the drill's subprocess half is tools/chaos_drill.py
+    --only flight-recorder watchdog-dump, pinned in test_tools)."""
+
+    def test_nan_abort_dump_last_record_is_failing_step(self, tmp_path):
+        from dcgan_tpu.train.trainer import train
+
+        chaos.set_plan(chaos.FaultPlan(nan_at_step=3))
+        cfg = _tiny_cfg(tmp_path, nan_check_steps=1)
+        with pytest.raises(FloatingPointError, match="step 3"):
+            train(cfg, synthetic_data=True, max_steps=6)
+        header, records = read_dump(
+            os.path.join(cfg.checkpoint_dir, "flight_recorder.jsonl"))
+        assert header["reason"] == "nan-abort" and header["step"] == 3
+        assert records[-1]["step"] == 3 and records[-1]["gate"] == "trip"
+        assert records[-1]["metrics"] and "d_loss" in records[-1]["metrics"]
+        assert "counters" in records[-1]
+
+    def test_coordinated_stop_dump(self, tmp_path):
+        from dcgan_tpu.train.trainer import train
+
+        chaos.set_plan(chaos.FaultPlan(sigterm_at_step=3))
+        cfg = _tiny_cfg(tmp_path)
+        state = train(cfg, synthetic_data=True, max_steps=6)
+        assert int(jax.device_get(state["step"])) == 3  # stopped early
+        header, records = read_dump(
+            os.path.join(cfg.checkpoint_dir, "flight_recorder.jsonl"))
+        assert header["reason"] == "coordinated-stop"
+        assert header["step"] == 3 and header["signal"] > 0
+        assert records and records[-1]["step"] <= 3
+
+    def test_services_exception_dump(self, tmp_path):
+        from dcgan_tpu.train.services import ServiceError
+        from dcgan_tpu.train.trainer import train
+
+        chaos.set_plan(chaos.FaultPlan(services_worker_crash=1))
+        cfg = _tiny_cfg(tmp_path, save_summaries_secs=0.0,
+                        log_every_steps=1)
+        with pytest.raises(ServiceError):
+            train(cfg, synthetic_data=True, max_steps=50)
+        header, _ = read_dump(
+            os.path.join(cfg.checkpoint_dir, "flight_recorder.jsonl"))
+        assert header["reason"] == "exception"
+        assert "ServiceError" in header["error"]
+
+    def test_pre_first_step_death_carries_startup_partial(self, tmp_path):
+        """The StartupProfile satellite: a run that dies before its first
+        step dumps the phases completed so far instead of losing them."""
+        from dcgan_tpu.train.trainer import train
+
+        cfg = _tiny_cfg(tmp_path, data_dir=str(tmp_path / "empty"))
+        # real-data mode with no shards on disk -> the loader raises
+        # inside _train_run, after the init phase but before any step
+        with pytest.raises(FileNotFoundError, match="no TFRecord shards"):
+            train(cfg, synthetic_data=False, max_steps=4)
+        header, records = read_dump(
+            os.path.join(cfg.checkpoint_dir, "flight_recorder.jsonl"))
+        assert header["reason"] == "exception" and records == []
+        partial = header["startup_partial"]
+        assert "perf/startup/init_ms" in partial
+        assert "perf/startup/total_ms" not in partial  # never reached
+
+    def test_flight_recorder_steps_zero_writes_nothing(self, tmp_path):
+        from dcgan_tpu.train.trainer import train
+
+        chaos.set_plan(chaos.FaultPlan(nan_at_step=2))
+        cfg = _tiny_cfg(tmp_path, nan_check_steps=1,
+                        flight_recorder_steps=0)
+        with pytest.raises(FloatingPointError):
+            train(cfg, synthetic_data=True, max_steps=4)
+        assert not os.path.exists(
+            os.path.join(cfg.checkpoint_dir, "flight_recorder.jsonl"))
+
+
+@pytest.mark.slow
+class TestFleetHealthEndToEnd:
+    def test_fleet_metrics_at_cadence(self, tmp_path):
+        """Single-process fleet plane: the same gather/derive path as
+        multi-host (1-row table), fleet/* scalars at the cadence, skew 0."""
+        from dcgan_tpu.train.trainer import train
+
+        cfg = _tiny_cfg(tmp_path, fleet_health_steps=2,
+                        save_summaries_secs=1e9, log_every_steps=1)
+        train(cfg, synthetic_data=True, max_steps=5)
+        fleet = {s: v for s, v in _scalar_rows(tmp_path)
+                 if "fleet/step_ms_max" in v}
+        assert set(fleet) == {2, 4}
+        row = fleet[4]
+        assert row["fleet/slowest_host"] == 0.0
+        assert row["fleet/step_ms_skew"] == 0.0
+        assert row["fleet/step_ms_max"] >= row["fleet/step_ms_min"] > 0.0
+        assert row["fleet/dropped_total"] == 0.0
+
+
+@pytest.mark.slow
+class TestObservabilityParity:
+    def test_defaults_vs_armed_jsonl_value_parity(self, tmp_path):
+        """The acceptance parity criterion: the new observability knobs
+        change what EXTRA telemetry exists, never the training values — a
+        default run and a fully-armed run (fleet cadence on, recorder on,
+        an untouched trigger file configured) carry identical scalar
+        values outside the new fleet/* keys, and the default stream has
+        none of the new keys at all."""
+        from dcgan_tpu.train.trainer import train
+
+        def run(root, **kw):
+            train(_tiny_cfg(root, nan_check_steps=1, log_every_steps=1,
+                            **kw), synthetic_data=True, max_steps=5)
+            rows = {}
+            for step, vals in _scalar_rows(root):
+                # perf/ timing keys are wall-clock — excluded like every
+                # prior parity test; fleet/ is the armed run's new family
+                rows[step] = {k: v for k, v in vals.items()
+                              if not k.startswith(("perf/", "fleet/"))}
+            return rows
+
+        a = run(tmp_path / "default")
+        b = run(tmp_path / "armed",
+                fleet_health_steps=1, flight_recorder_steps=16,
+                profile_trigger=str(tmp_path / "trigger-never-touched"))
+        assert a == b
+        # and the default stream never carries the new key families
+        for _, vals in _scalar_rows(tmp_path / "default"):
+            assert not any(k.startswith(("fleet/", "perf/device/"))
+                           for k in vals)
+        # the armed-but-untouched trigger captured nothing
+        for _, vals in _scalar_rows(tmp_path / "armed"):
+            assert not any(k.startswith("perf/device/") for k in vals)
+        # no crash -> no dump, even with the recorder armed
+        assert not os.path.exists(
+            str(tmp_path / "armed" / "ckpt" / "flight_recorder.jsonl"))

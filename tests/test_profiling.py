@@ -39,6 +39,17 @@ class TestStepTimer:
         t.tick(now=10.2)
         assert abs(t.summary()["perf/step_ms_max"] - 100.0) < 1e-3
 
+    def test_last_step_accessors(self):
+        """The flight recorder reads the most recent per-step numbers
+        without forcing a summary() sort."""
+        t = StepTimer(window=4)
+        assert t.last_step_ms is None and t.last_host_ms is None
+        t.tick(now=0.0)
+        t.note_host(0.02)
+        t.tick(now=0.1)
+        assert abs(t.last_step_ms - 100.0) < 1e-6
+        assert abs(t.last_host_ms - 20.0) < 1e-6
+
     def test_p90_on_skewed_window(self):
         t = StepTimer(window=20)
         now = 0.0
@@ -57,7 +68,7 @@ class TestTraceCapture:
     def test_disabled_when_no_logdir(self):
         tc = TraceCapture("", start_step=0, num_steps=5)
         tc.maybe_start(0)
-        assert not tc._active
+        assert not tc.active
         tc.maybe_stop(10)  # no-op, must not raise
 
     def test_capture_window(self, tmp_path):
@@ -69,19 +80,94 @@ class TestTraceCapture:
             tc.maybe_start(step)
             y = f(jax.numpy.ones((8,)))
             if step < 2:
-                assert not tc._active
+                assert not tc.active
             tc.maybe_stop(step + 1)
         y.block_until_ready()
-        assert tc._done and not tc._active
+        assert tc.captures == 1 and not tc.active
         # profiler wrote its event files under the logdir
         assert glob.glob(logdir + "/**/*", recursive=True)
 
     def test_close_stops_open_trace(self, tmp_path):
         tc = TraceCapture(str(tmp_path / "t"), start_step=0, num_steps=100)
         tc.maybe_start(0)
-        assert tc._active
+        assert tc.active
         tc.close()
-        assert not tc._active
+        assert not tc.active
+
+    def test_trigger_file_starts_and_stops_capture(self, tmp_path):
+        """ISSUE 6 on-demand tracing: touch -> capture the next N steps;
+        the file is consumed as the ack, on_capture fires with the stop
+        step, and a SECOND touch starts a second capture."""
+        trig = tmp_path / "trigger"
+        logdir = str(tmp_path / "trace")
+        captured = []
+        tc = TraceCapture(logdir, num_steps=2, schedule=False,
+                          trigger_path=str(trig),
+                          on_capture=captured.append)
+        f = jax.jit(lambda x: x + 1.0)
+
+        y = None
+        for step in range(8):
+            if step == 2:
+                trig.touch()
+            tc.maybe_start(step)
+            if step < 2:
+                assert not tc.active  # untouched: never starts
+            y = f(jax.numpy.ones((4,)))
+            tc.maybe_stop(step + 1, sync=y)
+        assert captured == [4]            # started at 2, N=2 -> stop at 4
+        assert not trig.exists()          # consumed as the ack
+        assert tc.captures == 1
+        assert glob.glob(logdir + "/**/*.trace.json.gz", recursive=True)
+
+        trig.touch()                      # second touch, second capture
+        tc.maybe_start(8)
+        assert tc.active
+        tc.maybe_stop(10, sync=y)
+        assert captured == [4, 10] and tc.captures == 2
+
+    def test_no_schedule_means_trigger_only(self, tmp_path):
+        """schedule=False (the trainer's trigger-only mode) must never arm
+        the scheduled start_step window."""
+        tc = TraceCapture(str(tmp_path / "t"), start_step=0, num_steps=5,
+                          schedule=False, trigger_path=str(tmp_path / "x"))
+        tc.maybe_start(100)
+        assert not tc.active
+
+    def test_trigger_without_logdir_is_inert(self, tmp_path):
+        trig = tmp_path / "trigger"
+        trig.touch()
+        tc = TraceCapture("", trigger_path=str(trig))
+        tc.maybe_start(0)
+        assert not tc.active and trig.exists()  # never consumed
+
+    def test_nonconsuming_process_captures_by_mtime(self, tmp_path):
+        """Multi-process shared-FS semantics: consume=False (non-chief)
+        captures on a NEW mtime and does NOT delete the file — and one
+        mtime serves exactly one capture even if the chief's removal is
+        delayed, so losing the remove race can no longer starve the
+        digesting process (review fix)."""
+        import os
+        import time as time_mod
+
+        trig = tmp_path / "trigger"
+        trig.touch()
+        captured = []
+        tc = TraceCapture(str(tmp_path / "tr"), num_steps=1,
+                          schedule=False, trigger_path=str(trig),
+                          consume=False, on_capture=captured.append)
+        f = jax.jit(lambda x: x - 1.0)
+        tc.maybe_start(0)
+        assert tc.active and trig.exists()      # captured, NOT consumed
+        tc.maybe_stop(1, sync=f(jax.numpy.ones(2)))
+        tc.maybe_start(2)
+        assert not tc.active                    # same mtime: already served
+        time_mod.sleep(0.01)
+        os.utime(trig)                          # a fresh touch re-arms
+        tc.maybe_start(3)
+        assert tc.active
+        tc.close()
+        assert captured == [1]
 
 
 class TestTrainerWiring:

@@ -220,7 +220,8 @@ class TestTraceSummary:
     def test_committed_chip_trace_parses(self):
         """The committed v5e trace artifact must keep yielding the step-time
         evidence DESIGN.md §1b cites: 5 per-step train_step executions at
-        ~2.845 ms on the device's own timeline."""
+        ~2.845 ms on the device's own timeline (now through the shared
+        dcgan_tpu/utils/trace.py parser — satellite reroute)."""
         from tools.trace_summary import find_trace, summarize
 
         rows = summarize(find_trace(os.path.join(
@@ -239,6 +240,58 @@ class TestTraceSummary:
         p = d / "vm.trace.json.gz"
         p.write_bytes(b"")
         assert find_trace(str(tmp_path)) == str(p)
+
+    def _write_trace(self, path, events):
+        import gzip
+
+        with gzip.open(str(path), "wt") as f:
+            json.dump({"traceEvents": events}, f)
+        return str(path)
+
+    def test_cpu_capture_falls_back_instead_of_printing_nothing(
+            self, tmp_path):
+        """Satellite fix: a no-TPU capture used to print NOTHING and exit
+        0 — now it reports the busiest fallback track with a stderr note."""
+        path = self._write_trace(tmp_path / "c.trace.json.gz", [
+            {"ph": "M", "pid": 7, "name": "process_name",
+             "args": {"name": "/host:CPU"}},
+            {"ph": "M", "pid": 7, "tid": 2, "name": "thread_name",
+             "args": {"name": "tf_XLATfrtCpuClient/1"}},
+            {"ph": "X", "pid": 7, "tid": 2, "name": "dot.1",
+             "ts": 0, "dur": 500}])
+        res = subprocess.run(
+            [sys.executable, "tools/trace_summary.py", path], cwd=REPO,
+            capture_output=True, text=True, timeout=120)
+        assert res.returncode == 0, res.stderr
+        rows = [json.loads(l) for l in res.stdout.splitlines()]
+        assert rows and rows[0]["program"] == "dot.1"
+        assert "no TPU-named process" in res.stderr
+
+    def test_no_device_events_exits_nonzero_with_hint(self, tmp_path):
+        path = self._write_trace(tmp_path / "e.trace.json.gz", [
+            {"ph": "M", "pid": 7, "name": "process_name",
+             "args": {"name": "/host:CPU"}}])
+        res = subprocess.run(
+            [sys.executable, "tools/trace_summary.py", path], cwd=REPO,
+            capture_output=True, text=True, timeout=120)
+        assert res.returncode == 1
+        assert res.stdout.strip() == ""
+        assert "no duration events" in res.stderr
+        assert "--profile_dir" in res.stderr  # the usage hint
+
+    def test_committed_chip_trace_digest(self):
+        """The v5e artifact is also the DIGEST regression fixture (ISSUE 6
+        satellite): device attribution over the capture — ~14.25 ms busy
+        across 5 steps, the rest idle between dispatches."""
+        from dcgan_tpu.utils.trace import digest
+
+        d = digest(os.path.join(REPO, "docs", "assets",
+                                "trace_train_step_v5e.json.gz"))
+        assert d["source"] == "tpu"
+        assert 2.8 < d["program_ms_median"] < 2.9  # devstep_ms source
+        assert 14.0 < d["compute_ms"] < 15.0
+        assert 40.0 < d["idle_gap_ms"] < 50.0
+        assert d["collective_ms"] == 0.0
 
 
 class TestTrainerLoopParsing:
@@ -262,8 +315,9 @@ class TestChaosDrillSmoke:
     chaos-marker contract in pytest.ini): the cheap scenario subset —
     corrupt-record quarantine, transient-IO retry, services-crash
     surfacing — must keep passing end to end through real trainer
-    subprocesses. The full 6-scenario matrix (rollback + checkpoint
-    fallback included) runs standalone: `python tools/chaos_drill.py`."""
+    subprocesses. The full 9-scenario matrix (rollback + checkpoint
+    fallback + the ISSUE 6 observability trio included) runs standalone:
+    `python tools/chaos_drill.py`."""
 
     def test_smoke_matrix_passes(self):
         res = subprocess.run(
@@ -310,6 +364,40 @@ class TestChaosDrillSmoke:
         # runtime budget: two tiny 2-process launches; 300 s is ~4x the
         # measured cost on a quiet host, headroom for CI contention
         assert elapsed < 300, f"multihost smoke took {elapsed:.0f}s"
+
+
+@pytest.mark.chaos
+class TestObservabilitySmoke:
+    """ISSUE 6's tier-1 pin (chaos-marker pattern from PRs 3-5): the
+    trigger-file capture -> in-process digest loop and the flight-recorder
+    dump triggers must keep working end to end through real trainer
+    subprocesses, inside an explicit runtime budget. The full matrix runs
+    standalone: `JAX_PLATFORMS=cpu python tools/chaos_drill.py`."""
+
+    def test_trace_trigger_and_flight_recorder_within_budget(self):
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "tools/chaos_drill.py", "--only",
+             "flight-recorder", "watchdog-dump", "trace-trigger"],
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        elapsed = time.monotonic() - t0
+        lines = [json.loads(l) for l in res.stdout.splitlines()
+                 if l.startswith("{")]
+        summary = lines[-1]
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
+        assert summary["scenarios"] == 3 and summary["failed"] == 0
+        scenarios = {p["scenario"]: p for p in lines if "scenario" in p}
+        assert set(scenarios) == {"flight-recorder", "watchdog-dump",
+                                  "trace-trigger"}
+        assert scenarios["flight-recorder"]["failing_step"] == 3
+        assert scenarios["watchdog-dump"]["phase"] == "step-dispatch"
+        assert scenarios["trace-trigger"]["device_compute_ms"] > 0
+        # three tiny trainer subprocesses (~15 s each on a quiet host,
+        # compile-dominated); ~4x headroom for CI contention
+        assert elapsed < 300, f"observability smoke took {elapsed:.0f}s"
 
 
 @pytest.mark.chaos

@@ -120,7 +120,9 @@ def _occupancy_mode() -> None:
 
 def main() -> None:
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    devstep_ms = None
     with tempfile.TemporaryDirectory() as tmp:
+        trace_dir = os.path.join(tmp, "trace")
         argv = [
             sys.executable, "-m", "dcgan_tpu.train",
             "--synthetic",
@@ -147,9 +149,27 @@ def main() -> None:
             "--checkpoint_dir", os.path.join(tmp, "ckpt"),
             "--sample_dir", os.path.join(tmp, "samples"),
         ]
+        if os.environ.get("TRAINER_BENCH_DEVSTEP", "1") != "0":
+            # devstep_ms (ISSUE 6): one scanned call traced at the very
+            # END of the run (steady state; the capture's overhead sits in
+            # <=SCAN of the MAX_STEPS-step measurement window) and
+            # digested through the shared parser — the BENCH row carries
+            # the device's own step time next to the host-derived number
+            argv += ["--profile_dir", trace_dir,
+                     "--profile_start_step", str(max(0, MAX_STEPS - SCAN)),
+                     "--profile_num_steps", str(SCAN)]
         res = subprocess.run(argv, cwd=repo, capture_output=True, text=True,
                              timeout=float(os.environ.get(
                                  "TRAINER_BENCH_TIMEOUT", 900)))
+        if os.path.isdir(trace_dir):
+            try:
+                sys.path.insert(0, repo)
+                from dcgan_tpu.utils.trace import devstep_ms as devstep_of
+
+                # the captured window is one steps_per_call scan program
+                devstep_ms = devstep_of(trace_dir, per_exec=SCAN)
+            except Exception as e:  # noqa: BLE001 — the field is optional
+                print(f"devstep digest failed: {e!r}", file=sys.stderr)
     sys.stderr.write((res.stderr or "")[-2000:])
     if res.returncode != 0:
         print(json.dumps({"label": "trainer-loop", "error":
@@ -173,6 +193,7 @@ def main() -> None:
         "label": "trainer-loop",
         "images_per_sec_chip": round(rate, 1),
         "ms_per_step": round((t2 - t1) / steps * 1e3, 2),
+        "devstep_ms": round(devstep_ms, 4) if devstep_ms else None,
         "window_steps": [s1, s2],
         "batch": batch, "steps_per_call": SCAN,
         "total_steps": MAX_STEPS,

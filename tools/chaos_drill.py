@@ -27,6 +27,15 @@ never silently trains garbage, never hangs.
     services-crash        background services worker     ServiceError surfaces
                           dies                           on the dispatch
                                                          thread, run aborts
+    flight-recorder       NaN under the default abort    flight-recorder dump
+                          policy                         written; last record
+                                                         = the failing step
+    watchdog-dump         hang inside the guarded        watchdog trip dumps
+                          dispatch window (1-process)    stacks AND the
+                                                         telemetry ring
+    trace-trigger         (no fault) pre-touched         N-step capture +
+                          --profile_trigger file         in-process digest ->
+                                                         perf/device/* events
 
 Multi-host matrix (ISSUE 4, `--multihost`): the same contract under a REAL
 2-process jax.distributed job over localhost gRPC (tests/multihost_worker.py
@@ -266,6 +275,93 @@ def scenario_services_crash(root: str) -> dict:
     return {"failed_as_required": True}
 
 
+def scenario_flight_recorder(root: str) -> dict:
+    """NaN under the default abort policy -> the run dies loudly AND
+    leaves a parseable flight-recorder dump whose LAST record is the
+    failing step with a tripped gate verdict (ISSUE 6: the stacks' missing
+    telemetry context)."""
+    from dcgan_tpu.train.flight_recorder import read_dump
+
+    ck = os.path.join(root, "ck")
+    rc, out = _run_train(
+        dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+             nan_check_steps=1, save_model_secs=1e9),
+        max_steps=6, chaos={"nan_at_step": 3})
+    _check(rc != 0, "NaN-abort run unexpectedly succeeded")
+    _check("non-finite training metrics at step 3" in out,
+           f"no NaN abort message: {out[-800:]}")
+    path = os.path.join(ck, "flight_recorder.jsonl")
+    _check(os.path.exists(path), "no flight-recorder dump after NaN abort")
+    header, records = read_dump(path)
+    _check(header["reason"] == "nan-abort" and header["step"] == 3,
+           f"dump header misattributes the abort: {header}")
+    _check(records and records[-1]["step"] == 3
+           and records[-1]["gate"] == "trip",
+           f"last record is not the tripped step: {records[-1:]}")
+    _check(all("counters" in r for r in records),
+           "records missing the counter-registry snapshot")
+    return {"reason": header["reason"], "dump_records": len(records),
+            "failing_step": records[-1]["step"]}
+
+
+def scenario_watchdog_dump(root: str) -> dict:
+    """Single-process watchdog trip (a hang inside the guarded dispatch
+    window) -> stack dump + exit 43 as before, now joined by a
+    flight-recorder dump naming the phase (ISSUE 6)."""
+    from dcgan_tpu.train.flight_recorder import read_dump
+
+    ck = os.path.join(root, "ck")
+    rc, out = _run_train(
+        dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+             collective_timeout_secs=3.0, save_model_secs=1e9),
+        max_steps=20, chaos={"hang_at_step": 3, "hang_secs": 60},
+        timeout=180)
+    _check(rc != 0, "hung run unexpectedly succeeded")
+    _check("hung-collective watchdog" in out or "Timeout (" in out,
+           f"no watchdog diagnostic: {out[-800:]}")
+    _check("TRAIN_DONE" not in out, "hung run claimed completion")
+    path = os.path.join(ck, "flight_recorder.jsonl")
+    _check(os.path.exists(path), "no flight-recorder dump on watchdog trip")
+    header, records = read_dump(path)
+    _check(header["reason"] == "watchdog"
+           and header.get("phase") == "step-dispatch",
+           f"dump header misattributes the trip: {header}")
+    _check(header["step"] == 3, f"dump header wrong step: {header}")
+    _check(records and records[-1]["step"] >= 1,
+           f"ring empty at trip: {records[-1:]}")
+    return {"rc": rc, "phase": header["phase"],
+            "dump_records": len(records)}
+
+
+def scenario_trace_trigger(root: str) -> dict:
+    """A touched --profile_trigger file -> the next boundary starts an
+    N-step device capture, the services worker digests it in-process, and
+    perf/device/* attribution (compute/collective/idle-gap/step) lands in
+    the event stream; the trigger file is consumed as the ack."""
+    trig = os.path.join(root, "trigger")
+    open(trig, "w").close()   # pre-touched: fires at the first boundary
+    ck = os.path.join(root, "ck")
+    rc, out = _run_train(
+        dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+             profile_trigger=trig, profile_num_steps=2, save_model_secs=1e9),
+        max_steps=6)
+    _check(rc == 0, f"trainer failed (rc={rc}): {out[-800:]}")
+    _check("TRAIN_DONE step=6" in out, f"run did not complete: {out[-400:]}")
+    _check(not os.path.exists(trig), "trigger file was not consumed")
+    _check("trace digest" in out, f"no digest log line: {out[-800:]}")
+    keys = ("perf/device/compute_ms", "perf/device/collective_ms",
+            "perf/device/idle_gap_ms", "perf/device/step_ms")
+    rows = [e["values"] for e in _events(ck) if e["kind"] == "scalars"
+            and "perf/device/compute_ms" in e["values"]]
+    _check(rows, "no perf/device/* events after the trigger capture")
+    missing = [k for k in keys if k not in rows[-1]]
+    _check(not missing, f"digest row missing {missing}")
+    _check(rows[-1]["perf/device/compute_ms"] > 0,
+           f"empty device attribution: {rows[-1]}")
+    return {"device_compute_ms": round(rows[-1][keys[0]], 3),
+            "device_idle_gap_ms": round(rows[-1][keys[2]], 3)}
+
+
 SCENARIOS = {
     "nan-rollback": scenario_nan_rollback,
     "corrupt-record": scenario_corrupt_record,
@@ -273,6 +369,9 @@ SCENARIOS = {
     "truncate-checkpoint": scenario_truncate_checkpoint,
     "io-error-once": scenario_io_error_once,
     "services-crash": scenario_services_crash,
+    "flight-recorder": scenario_flight_recorder,
+    "watchdog-dump": scenario_watchdog_dump,
+    "trace-trigger": scenario_trace_trigger,
 }
 
 
@@ -474,6 +573,17 @@ def scenario_mh_watchdog(root: str) -> dict:
                or "collective-save" in out0,
                f"watchdog header does not name the blocked phase: "
                f"{out0[-800:]}")
+        # ISSUE 6: the Python-watchdog trip path (not the GIL-immune
+        # C backstop, which cannot run Python) also ships the chief's
+        # flight-recorder ring
+        from dcgan_tpu.train.flight_recorder import read_dump
+
+        dump = os.path.join(root, "ck", "flight_recorder.jsonl")
+        _check(os.path.exists(dump),
+               "no flight-recorder dump on the blocked chief")
+        header, _ = read_dump(dump)
+        _check(header["reason"] == "watchdog",
+               f"dump header misattributes the trip: {header}")
     return {"exit_codes": [rc for rc, _ in results],
             "watchdog_rc": rc0}
 

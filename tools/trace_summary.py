@@ -1,17 +1,26 @@
 """Summarize a jax.profiler trace: device program durations per step.
 
 The tracing subsystem (utils/profiling.py::TraceCapture, wired into the
-trainer as --profile_dir/--profile_start_step/--profile_num_steps) captures
-a Chrome-trace timeline of the training loop. This tool reads the
-`*.trace.json.gz` it writes and reports, for each device-track program,
-the execution count and per-execution duration — the device's OWN
-measurement of step time, independent of every host-side wall-clock
-harness (bench.py, StepTimer, tools/step_profile.py all sync through the
-transport; the trace does not).
+trainer as --profile_dir/--profile_start_step/--profile_num_steps, plus the
+on-demand --profile_trigger file) captures a Chrome-trace timeline of the
+training loop. This tool reads the `*.trace.json.gz` it writes and reports,
+for each device-track program, the execution count and per-execution
+duration — the device's OWN measurement of step time, independent of every
+host-side wall-clock harness (bench.py, StepTimer, tools/step_profile.py
+all sync through the transport; the trace does not).
 
     python -m dcgan_tpu.train --synthetic --profile_dir /tmp/tr ...
     python tools/trace_summary.py /tmp/tr
     python tools/trace_summary.py docs/assets/trace_train_step_v5e.json.gz
+
+The parser lives in dcgan_tpu/utils/trace.py (ISSUE 6) — the same code the
+trainer uses to digest trigger-file captures in-process — so this tool and
+the live perf/device/* events can never disagree about what a trace says.
+CPU captures have no TPU-named process; the shared parser falls back to
+the busiest XLA-executor (or non-python) thread track and this tool says
+so on stderr instead of silently printing nothing (the pre-ISSUE-6
+behavior). A trace with no duration events at all exits nonzero with a
+usage hint.
 
 The committed artifact docs/assets/trace_train_step_v5e.json.gz is a real
 v5e capture of 5 per-step train_step dispatches: 2.8441-2.8458 ms each
@@ -21,56 +30,26 @@ events only — per-XLA-op rows are not available through it, which is why
 the §1b component split uses tools/step_profile.py's compiled sub-programs
 instead.
 
-Prints one JSON line per device program plus a host-overhead line.
+Prints one JSON line per device program.
 """
 
 from __future__ import annotations
 
-import glob
-import gzip
 import json
 import os
 import sys
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-def find_trace(path: str) -> str:
-    """Accept a trace file or a --profile_dir root (finds the newest)."""
-    if os.path.isfile(path):
-        return path
-    hits = sorted(glob.glob(os.path.join(
-        path, "**", "*.trace.json.gz"), recursive=True))
-    if not hits:
-        raise FileNotFoundError(f"no *.trace.json.gz under {path}")
-    return hits[-1]
+from dcgan_tpu.utils.trace import find_trace  # noqa: E402
+from dcgan_tpu.utils.trace import summarize as _summarize  # noqa: E402
 
 
 def summarize(trace_path: str) -> list:
-    with gzip.open(trace_path) as f:
-        data = json.load(f)
-    events = data.get("traceEvents", [])
-    device_pids = {e["pid"] for e in events
-                   if e.get("ph") == "M" and e.get("name") == "process_name"
-                   and "TPU" in str(e.get("args", {}).get("name", ""))}
-    rows: dict = {}
-    for e in events:
-        if e.get("ph") != "X" or "dur" not in e:
-            continue
-        if e.get("pid") not in device_pids:
-            continue
-        r = rows.setdefault(e["name"], {"n": 0, "durs": []})
-        r["n"] += 1
-        r["durs"].append(e["dur"] / 1e3)  # us -> ms
-    out = []
-    for name, r in sorted(rows.items(),
-                          key=lambda kv: -sum(kv[1]["durs"])):
-        ds = sorted(r["durs"])
-        out.append({
-            "program": name[:80], "n": r["n"],
-            "total_ms": round(sum(ds), 3),
-            "ms_min": round(ds[0], 4), "ms_max": round(ds[-1], 4),
-            "ms_median": round(ds[len(ds) // 2], 4),
-        })
-    return out
+    """Per-program rows (back-compat shim over the shared parser)."""
+    rows, _ = _summarize(trace_path)
+    return rows
 
 
 def main(argv=None) -> None:
@@ -80,7 +59,20 @@ def main(argv=None) -> None:
               file=sys.stderr)
         sys.exit(2)
     try:
-        for row in summarize(find_trace(args[0])):
+        path = find_trace(args[0])
+        rows, source = _summarize(path)
+        if not rows:
+            print(f"no duration events in {path} — capture one with "
+                  "`python -m dcgan_tpu.train --profile_dir <dir>` (or "
+                  "touch a --profile_trigger file mid-run) and point this "
+                  "tool at the dir or the *.trace.json.gz",
+                  file=sys.stderr)
+            sys.exit(1)
+        if source != "tpu":
+            print(f"note: no TPU-named process in {path}; reporting the "
+                  f"{source} track (CPU captures time host-side execution "
+                  "— device numbers need a chip capture)", file=sys.stderr)
+        for row in rows:
             print(json.dumps(row))
     except BrokenPipeError:  # e.g. piped into head
         sys.stderr.close()
