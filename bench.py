@@ -13,6 +13,8 @@ TF DCGAN-64 trainers at batch 64 sustain roughly 2000 images/sec, which we
 adopt (documented assumption) as baseline=2000 for vs_baseline.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+(PIPELINE_GD=1 prints an extra pipelined-G/D A/B row FIRST — see
+_bench_pipeline_ab — so the headline row stays the last line.)
 """
 
 from __future__ import annotations
@@ -109,6 +111,108 @@ def _bench_sample(cfg, pt, state, n_chips: int) -> None:
     print(json.dumps(row))
     print(f"chips={n_chips} batch={batch} calls={n_calls} wall={dt:.2f}s "
           f"ms_per_step={dt / n_calls * 1e3:.2f}", file=sys.stderr)
+
+
+def _bench_pipeline_ab(cfg, pt, n_chips: int, images, base) -> None:
+    """PIPELINE_GD=1: the pipelined G/D dispatch A/B row (ISSUE 7).
+
+    Measures the SAME config twice at per-step dispatch — the fused
+    train_step program vs the gen_fakes/d_update/g_update stage loop the
+    trainer runs under --pipeline_gd (driven through the trainer's own
+    GDPipeline buffer manager, so the benched dataflow is the shipped
+    one) — and prints one extra BENCH-style row with both arms'
+    ms_per_step + devstep_ms. Per-step FLOPs are conservation-equal
+    across the arms (tools/step_profile.py PIPELINE_GD=1 proves it), so
+    this row is the regression guard that the stage split's extra
+    dispatches stay in the noise, not a speedup claim. Printed BEFORE
+    the headline row so the driver's last-line parse is unchanged.
+    """
+    import jax
+
+    from dcgan_tpu.train.gd_pipeline import GDPipeline
+
+    steps = max(1, int(os.environ.get("BENCH_PIPELINE_STEPS",
+                                      min(STEPS_MEASURE, 60))))
+    windows = int(os.environ.get("BENCH_WINDOWS", 3))
+
+    def _fused(state, step_idx):
+        for _ in range(steps):
+            state, metrics = pt.step(state, images,
+                                     jax.random.fold_in(base, step_idx))
+            step_idx += 1
+        return state, metrics, step_idx
+
+    pipe = GDPipeline()
+
+    def _pipelined(state, step_idx):
+        for _ in range(steps):
+            state, metrics = pipe.step(pt, state, images,
+                                       jax.random.fold_in(base, step_idx))
+            step_idx += 1
+        return state, metrics, step_idx
+
+    arms = {}
+    for arm, run in (("fused", _fused), ("pipelined", _pipelined)):
+        # fresh state per arm (donation consumed the other arm's): arms
+        # must not share optimizer history either
+        st = pt.init(jax.random.key(0))
+        step_idx = 0
+        st, metrics, step_idx = run(st, step_idx)        # compile + warmup
+        float(metrics["d_loss"])                         # value-readback sync
+        dt = float("inf")
+        for _ in range(windows):
+            t0 = time.perf_counter()
+            st, metrics, step_idx = run(st, step_idx)
+            float(metrics["d_loss"])
+            dt = min(dt, time.perf_counter() - t0)
+        devstep = None
+        if os.environ.get("BENCH_DEVSTEP", "1") != "0":
+            try:
+                import tempfile
+
+                from dcgan_tpu.utils.trace import digest, find_trace, \
+                    stage_step_ms
+                with tempfile.TemporaryDirectory() as td:
+                    jax.profiler.start_trace(td)
+                    try:
+                        st, metrics, step_idx = run(st, step_idx)
+                        float(metrics["d_loss"])
+                    finally:
+                        jax.profiler.stop_trace()
+                    d = digest(find_trace(td))
+                    if d["source"] != "none" and d["program_ms_median"] > 0:
+                        # stage-summed per-step time when the track names
+                        # the stage programs (TPU module tracks); busiest-
+                        # program median otherwise — same convention as the
+                        # trainer's perf/device/step_ms
+                        devstep = (stage_step_ms(d)
+                                   if arm == "pipelined" else 0.0) \
+                            or d["program_ms_median"]
+            except Exception as e:  # noqa: BLE001 — the field is optional
+                print(f"{arm} devstep capture failed: {e!r}", file=sys.stderr)
+        arms[arm] = {
+            "ms_per_step": round(dt / steps * 1e3, 3),
+            "images_per_sec_chip": round(
+                cfg.batch_size * steps / dt / n_chips, 1),
+            "devstep_ms": round(devstep, 4) if devstep else None,
+        }
+        pipe.drain("bench-arm-end")
+    f, p = arms["fused"], arms["pipelined"]
+    speedup = f["ms_per_step"] / p["ms_per_step"] \
+        if p["ms_per_step"] > 0 else None
+    arch = os.environ.get("BENCH_PRESET", "") or (
+        f"DCGAN-{cfg.model.output_size}")
+    print(json.dumps({
+        "metric": f"{arch} pipelined G/D A/B (batch {BATCH}/chip, "
+                  "per-step dispatch, bf16)",
+        "value": p["images_per_sec_chip"],
+        "unit": "images/sec/chip",
+        "vs_baseline": round(p["images_per_sec_chip"]
+                             / V100_TF_BASELINE_IMG_PER_SEC, 3),
+        "fused": f, "pipelined": p,
+        # unitless ratio: fused ms_per_step / pipelined ms_per_step
+        "fused_over_pipelined": round(speedup, 4) if speedup else None,
+    }))
 
 
 def main() -> None:
@@ -302,6 +406,14 @@ def main() -> None:
         # host overhead, the split the captures log could not see before
         "devstep_ms": round(devstep_ms, 4) if devstep_ms else None,
     }
+    if os.environ.get("PIPELINE_GD") == "1":
+        # the pipelined G/D A/B row (ISSUE 7) — printed before the headline
+        # row so the driver's last-line parse contract is unchanged
+        if cfg.model.num_classes or cfg.update_mode != "sequential":
+            print("PIPELINE_GD=1 skipped: pipelined stages are "
+                  "unconditional sequential-update only", file=sys.stderr)
+        else:
+            _bench_pipeline_ab(cfg, pt, n_chips, images, base)
     if cfg.model.attn_res:
         # Attention-bearing configs stamp the generation of the attention
         # code they actually EXECUTE — flash kernels or the dense path —

@@ -521,6 +521,33 @@ class TrainConfig:
                                    # stdout logging (the reference's
                                    # every-step line) only reports each
                                    # call's last step
+    pipeline_gd: bool = False      # software-pipelined G/D dispatch
+                                   # (ISSUE 7, ParaGAN's separable-stage
+                                   # framing): the fused train step is
+                                   # dispatched as three stage programs —
+                                   # gen_fakes (fill), d_update (consumes
+                                   # the fake stack produced during the
+                                   # PREVIOUS step, staleness 1), g_update
+                                   # (returns the next stack). Per-step
+                                   # FLOPs are conservation-equal to the
+                                   # fused program (every consumed fake is
+                                   # produced once; XLA already CSEs the
+                                   # fused step's shared-z G forward) —
+                                   # the wins are the largest program's
+                                   # peak temp memory (~15% below fused at
+                                   # the flagship config: batch headroom)
+                                   # and the stage separation itself (the
+                                   # substrate for cross-stage placement/
+                                   # overlap, DESIGN.md §6f). The stack is
+                                   # double-buffered on device and lives
+                                   # OUTSIDE the checkpoint pytree (both
+                                   # modes save/restore the identical
+                                   # state tree); fill/drain at run start,
+                                   # checkpoint boundaries, rollback, and
+                                   # coordinated stop. Sequential
+                                   # update_mode + unconditional models +
+                                   # steps_per_call=1 only. False = the
+                                   # fused step (reference parity)
     backend: str = "gspmd"         # "gspmd": jit + sharding annotations, the
                                    # partitioner inserts collectives
                                    # (parallel/api.py) | "shard_map": explicit
@@ -666,6 +693,22 @@ class TrainConfig:
             raise ValueError(
                 "update_mode='fused' (reference-parity single fused step) is "
                 "defined only for n_critic=1")
+        if self.pipeline_gd:
+            if self.update_mode != "sequential":
+                raise ValueError(
+                    "pipeline_gd dispatches g_update AFTER d_update "
+                    "(sequential semantics by construction); "
+                    "update_mode='fused' has no pipelined equivalent")
+            if self.model.num_classes:
+                raise ValueError(
+                    "pipeline_gd supports unconditional models only — the "
+                    "stage programs do not thread class labels through the "
+                    "fake stack")
+            if self.steps_per_call != 1:
+                raise ValueError(
+                    f"pipeline_gd dispatches per-step stage programs; it "
+                    f"does not compose with the scanned multi-step path "
+                    f"(steps_per_call={self.steps_per_call} — set it to 1)")
         if self.prefetch_device_batches < 0:
             raise ValueError(
                 f"prefetch_device_batches must be >= 0, got "

@@ -52,10 +52,23 @@ class ParallelTrain:
                            # host dispatch instead of K (the host round-trip
                            # the reference paid per step, SURVEY.md §2.4 #10,
                            # amortized K-fold)
+    # pipelined stage programs (ISSUE 7, --pipeline_gd; unconditional
+    # models only — traced lazily, so merely building them for a
+    # conditional config is harmless):
+    gen_fakes: Callable    # (state, key) -> [n_critic, B, H, W, C] fake
+                           # stack — the fill/refill program
+    d_update: Callable     # (state, images, fakes, key) -> (state,
+                           # metrics): critic update(s) consuming the
+                           # provided stack (dead after this dispatch —
+                           # the trainer's buffer manager drops it)
+    g_update: Callable     # (state, key) -> (state, fakes, metrics):
+                           # generator update returning the next step's
+                           # d_update input (staleness 1)
     programs: Dict[str, Callable] = dataclasses.field(default_factory=dict)
                            # the same jitted surfaces under stable names
                            # ("init", "train_step", "multi_step", "sampler",
-                           # "summarize", "eval_losses") — the enumeration
+                           # "summarize", "eval_losses", "gen_fakes",
+                           # "d_update", "g_update") — the enumeration
                            # the AOT warmup phase (train/warmup.py) lowers
                            # and the per-program perf/compile_ms keys are
                            # reported under; derived from the fields in
@@ -68,7 +81,9 @@ class ParallelTrain:
                 "init": self.init, "train_step": self.step,
                 "multi_step": self.multi_step, "sampler": self.sample,
                 "summarize": self.summarize,
-                "eval_losses": self.eval_losses})
+                "eval_losses": self.eval_losses,
+                "gen_fakes": self.gen_fakes, "d_update": self.d_update,
+                "g_update": self.g_update})
 
 
 def make_multi_step_body(step_fn: Callable) -> Callable:
@@ -240,7 +255,29 @@ def make_parallel_train(cfg: TrainConfig,
             out_shardings=(shardings, rep),
             donate_argnums=(0,))
 
+    # Pipelined stage programs (ISSUE 7): the fake stack is image-shaped
+    # with the n_critic slot axis in front — the same scan-axis-in-front
+    # sharding the multi_step inputs use (batch sharded on axis 1, slot
+    # axis unsharded). Only the state is donated: the consumed fake stack
+    # is dead after the dispatch too, but d_update has no fake-shaped
+    # output to alias it onto, so donating it would be a no-op plus a
+    # donation warning per compile — the trainer's buffer manager frees
+    # it by dropping its reference instead (gd_pipeline.py).
+    fake_sh = _scan_sh(img_sh)
+    gen_fakes = jax.jit(fns.gen_fakes,
+                        in_shardings=(shardings, rep),
+                        out_shardings=fake_sh)
+    d_update = jax.jit(fns.d_update,
+                       in_shardings=(shardings, img_sh, fake_sh, rep),
+                       out_shardings=(shardings, rep),
+                       donate_argnums=(0,))
+    g_update = jax.jit(fns.g_update,
+                       in_shardings=(shardings, rep),
+                       out_shardings=(shardings, fake_sh, rep),
+                       donate_argnums=(0,))
+
     return ParallelTrain(mesh=mesh, cfg=cfg, shardings=shardings,
                          init=init, step=step, sample=sample,
                          summarize=summarize, eval_losses=eval_losses,
-                         multi_step=multi_step)
+                         multi_step=multi_step, gen_fakes=gen_fakes,
+                         d_update=d_update, g_update=g_update)

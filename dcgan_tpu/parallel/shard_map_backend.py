@@ -71,7 +71,12 @@ def make_shard_map_train(cfg: TrainConfig,
             f"(batch_size/grad_accum) must divide over {n_shards} data "
             "shards")
 
-    fns = make_train_step(cfg, axis_name=DATA_AXIS)
+    fns = make_train_step(cfg, axis_name=DATA_AXIS,
+                          # the pipelined stages' generator batches are
+                          # per-shard inside shard_map (the fused step
+                          # derives shapes from its sharded images arg;
+                          # these stages have no images arg to read)
+                          local_batch=cfg.batch_size // n_shards)
     conditional = cfg.model.num_classes > 0
     # The varying-manner checker needs `vma` annotations on every
     # ShapeDtypeStruct a pallas_call emits, which the kernels (written to be
@@ -165,9 +170,40 @@ def make_shard_map_train(cfg: TrainConfig,
 
     init = jax.jit(fns.init, out_shardings=rep)
 
+    # Pipelined stage programs (ISSUE 7): per-shard bodies with the same
+    # shard-index key fold as step_body (independent z per shard); the
+    # fake stack is batch-sharded on axis 1, slot axis unsharded —
+    # exactly what the consuming d_update's fake_spec declares. Traced
+    # lazily, so these cost nothing when --pipeline_gd is off.
+    fake_spec = P(None, *img_spec)
+
+    def gen_fakes_body(state, key):
+        key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+        return fns.gen_fakes(state, key)
+
+    def d_update_body(state, images, fakes, key):
+        key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+        return fns.d_update(state, images, fakes, key)
+
+    def g_update_body(state, key):
+        key = jax.random.fold_in(key, lax.axis_index(DATA_AXIS))
+        return fns.g_update(state, key)
+
+    gen_fakes = jax.jit(smap(gen_fakes_body, (P(), P()), fake_spec))
+    d_update = jax.jit(
+        # state-only donation: the consumed stack has no same-shaped
+        # output to alias onto (see parallel/api.py) — the trainer's
+        # buffer manager frees it by reference drop instead
+        smap(d_update_body, (P(), img_spec, fake_spec, P()), (P(), P())),
+        donate_argnums=(0,))
+    g_update = jax.jit(
+        smap(g_update_body, (P(), P()), (P(), fake_spec, P())),
+        donate_argnums=(0,))
+
     shardings = jax.tree_util.tree_map(
         lambda _: rep, jax.eval_shape(fns.init, jax.random.key(0)))
     return ParallelTrain(mesh=mesh, cfg=cfg, shardings=shardings,
                          init=init, step=step, sample=sample,
                          summarize=summarize, eval_losses=eval_losses,
-                         multi_step=multi_step)
+                         multi_step=multi_step, gen_fakes=gen_fakes,
+                         d_update=d_update, g_update=g_update)

@@ -271,6 +271,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="EMA decay for a shadow copy of generator weights "
                         "used for sampling (0 = off, reference parity; "
                         "typical 0.999)")
+    p.add_argument("--pipeline_gd", type=_parse_bool, default=False,
+                   metavar="{true,false}",
+                   help="software-pipelined G/D dispatch: the step runs as "
+                        "separable stage programs (gen_fakes / d_update / "
+                        "g_update) with the D step consuming the fake "
+                        "batch produced during the previous step "
+                        "(staleness 1, double-buffered on device, outside "
+                        "the checkpoint tree) — compute-neutral per step, "
+                        "but the largest program's peak temp memory drops "
+                        "~15% and the stage split is the substrate for "
+                        "cross-stage placement (DESIGN.md §6f). "
+                        "Sequential update_mode, unconditional models, "
+                        "steps_per_call=1 only")
     p.add_argument("--steps_per_call", type=int, default=1,
                    help=">1 dispatches K steps as one compiled scan program "
                         "(sheds per-dispatch RPC overhead; observability "
@@ -309,6 +322,7 @@ _FLAG_FIELDS = {
     "g_learning_rate": ("", "g_learning_rate"),
     "lr_schedule": ("", "lr_schedule"), "warmup_steps": ("", "warmup_steps"),
     "steps_per_call": ("", "steps_per_call"),
+    "pipeline_gd": ("", "pipeline_gd"),
     "dataset": ("", "dataset"), "data_dir": ("", "data_dir"),
     "sample_image_dir": ("", "sample_image_dir"),
     "record_dtype": ("", "record_dtype"),

@@ -25,6 +25,25 @@ Under jit-with-sharding (parallel/), gradient all-reduce and synced-BN moments
 are inserted by GSPMD; for explicit-collective execution (shard_map) pass
 `axis_name` and grads/metrics are pmean'd by hand. Both replace the reference's
 per-worker async parameter-server pulls/pushes (image_train.py:55-67).
+
+Pipelined stage split (ISSUE 7, ParaGAN's separable-stage framing): the same
+step semantics factored into three independently-dispatchable programs —
+`gen_fakes` (G forward producing a [n_critic, B, ...] fake stack, the fill/
+refill program), `d_update` (the critic update(s) CONSUMING a provided fake
+stack instead of regenerating it), and `g_update` (the generator update,
+which RETURNS the fake stack it generated so the next step's `d_update` can
+consume it at staleness 1). Per-step FLOPs are conservation-equal to the
+fused program — every consumed fake is produced exactly once, and XLA
+already CSEs the fused step's shared-z generator forward (cost-analysis-
+verified; DESIGN.md §6f) — the split's wins are the largest program's
+peak temp memory and the stage separation itself (cross-stage placement/
+overlap substrate). The stage bodies reuse the exact loss/penalty/
+accumulation code paths of the fused step (n_critic critic scan, grad_accum
+microbatch scan), so the two surfaces cannot drift; only the fake batch's
+PROVENANCE differs — fused regenerates per step, pipelined consumes the
+stack produced during the previous step. The stack lives OUTSIDE the
+checkpoint pytree (trainer-held device buffer): both modes save and restore
+the identical state tree.
 """
 
 from __future__ import annotations
@@ -139,12 +158,33 @@ class TrainStepFns:
     eval_losses: Callable  # (state, images, z[, labels]) -> loss metrics,
                            # no state update — the reference's sample-batch
                            # loss probe (image_train.py:179-192)
+    # pipelined stage programs (ISSUE 7; unconditional models only — the
+    # trainer's --pipeline_gd validation enforces that):
+    gen_fakes: Callable   # (state, key) -> [n_critic, B, H, W, C] fake
+                          # stack — fresh z per critic slot, train-mode BN
+                          # (updates discarded, like the fused D branch),
+                          # constrain_fake applied. The FILL program: run
+                          # start, restart, and rollback refill
+    d_update: Callable    # (state, images, fakes, key) -> (state, metrics):
+                          # the critic update(s) consuming a provided fake
+                          # stack; touches ONLY the disc half of the state
+                          # (params/opt/bn.disc) — gen/ema_gen/step ride
+                          # through untouched, so the tree shape is the
+                          # fused step's exactly
+    g_update: Callable    # (state, key) -> (state, fakes, metrics): the
+                          # generator update against the CURRENT D
+                          # (sequential semantics — the trainer dispatches
+                          # it after d_update), returning the fake stack it
+                          # generated from its PRE-update weights as the
+                          # next step's d_update input (staleness 1);
+                          # increments state["step"]
 
 
 def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                     constrain_fake: Optional[Callable] = None,
                     constrain_micro: Optional[Callable] = None,
-                    attn_mesh=None, pallas_mesh=None) -> TrainStepFns:
+                    attn_mesh=None, pallas_mesh=None,
+                    local_batch: Optional[int] = None) -> TrainStepFns:
     """constrain_fake, if given, is applied to every generator output that is
     fed to the discriminator during training. The parallel layer passes a
     `with_sharding_constraint` to the real-image sharding here when the mesh
@@ -159,6 +199,14 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
     the step inputs to scan-over-microbatches shardings (leading axis
     unsharded, batch sharded on axis 1) — without it the partitioner may
     shard the scan axis after the reshape, serializing the mesh.
+
+    local_batch: the batch size the pipelined stage programs (gen_fakes /
+    g_update, ISSUE 7) draw their z at. The fused step derives every batch
+    shape from its `images` argument, but gen_fakes/g_update take no images
+    — so the generator-side stages need the size stated. Defaults to
+    cfg.batch_size (the global batch — correct under jit-with-sharding,
+    where programs see global shapes); the shard_map backend passes its
+    per-device batch instead, since each shard's program sees local shapes.
     """
     mcfg = cfg.model
     opt_g = make_optimizer(cfg, cfg.g_learning_rate)   # TTUR-capable:
@@ -188,6 +236,31 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
     def _pmean(x):
         return lax.pmean(x, axis_name) if axis_name is not None else x
 
+    # --- grad_accum microbatch helpers, shared by the fused accum step and
+    # the pipelined stage bodies (ISSUE 7) so the accumulate-in-f32 /
+    # average-then-pmean semantics are single-sourced ----------------------
+
+    def _split_micro(x):
+        """(B, ...) -> (grad_accum, micro, ...) with the scan-axis sharding
+        constraint applied (see constrain_micro above)."""
+        K = cfg.grad_accum
+        out = x.reshape(K, x.shape[0] // K, *x.shape[1:])
+        return constrain_micro(out) if constrain_micro is not None else out
+
+    def _zeros_f32(tree):
+        # accumulate in f32 whatever the param dtype: K bf16 adds would
+        # round away low-magnitude contributions
+        return jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+
+    def _acc(acc, grads):
+        return jax.tree_util.tree_map(
+            lambda a, g: a + g.astype(jnp.float32), acc, grads)
+
+    def _avg(acc, like):
+        return _pmean(jax.tree_util.tree_map(
+            lambda a, p: (a / cfg.grad_accum).astype(p.dtype), acc, like))
+
     def _critic_streams(iter_key, batch):
         """Per-critic-iteration randomness: fresh z against the same real
         batch, the gradient-penalty key, and the DiffAugment key. One
@@ -207,20 +280,29 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         z0 = jnp.zeros((), jnp.float32)
         return lax.pcast(z0, axis_name, to="varying") if axis_name else z0
 
-    def _loss_metrics(d_loss, d_real, d_fake, g_loss, gp) -> dict:
-        # one assembly for train_step and eval_losses so the sample/* probe
-        # can never silently diverge from the training metrics; the gp slot
+    def _d_metrics(d_loss, d_real, d_fake, gp) -> dict:
+        # the discriminator half of the step's metric row — the fused
+        # assembly below and the pipelined d_update stage both build from
+        # this, so the two surfaces report identical keys; the gp slot
         # carries whichever penalty the config runs (WGAN-GP or R1)
         metrics = {
             "d_loss": _pmean(d_loss),
             "d_loss_real": _pmean(d_real),
             "d_loss_fake": _pmean(d_fake),
-            "g_loss": _pmean(g_loss),
         }
         if wgan:
             metrics["gp"] = _pmean(gp)
         elif r1:
             metrics["r1"] = _pmean(gp)
+        return metrics
+
+    def _loss_metrics(d_loss, d_real, d_fake, g_loss, gp) -> dict:
+        # one assembly for train_step and eval_losses so the sample/* probe
+        # can never silently diverge from the training metrics. (Key ORDER
+        # is irrelevant: jitted outputs flatten through the dict pytree,
+        # which sorts keys.)
+        metrics = _d_metrics(d_loss, d_real, d_fake, gp)
+        metrics["g_loss"] = _pmean(g_loss)
         return metrics
 
     def d_loss_fn(d_params: Pytree, g_params: Pytree, bn: Pytree,
@@ -231,6 +313,18 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                                   labels=labels, axis_name=axis_name,
                                   attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
         fake = _cf(fake)
+        return _d_loss_on_fake(d_params, bn, images, fake, gp_key, labels,
+                               step, r1_every_step, aug_key)
+
+    def _d_loss_on_fake(d_params: Pytree, bn: Pytree, images: jax.Array,
+                        fake: jax.Array, gp_key, labels, step=0,
+                        r1_every_step=False,
+                        aug_key=None) -> Tuple[jax.Array, Tuple]:
+        """The D loss on an ALREADY-MATERIALIZED fake batch — the shared
+        body of the fused step (which generates `fake` just above) and the
+        pipelined d_update stage (which consumes the previous step's
+        device-resident stack), so the two can never diverge on loss,
+        penalty, or BN-chaining semantics."""
         # D sees real then fake, chaining BN state through both applications —
         # the functional analogue of the reference's two discriminator() calls
         # with reuse=True (image_train.py:82,85). Each D input is
@@ -283,8 +377,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         return d_loss, (d_bn2, d_real, d_fake, gp)
 
     def g_loss_fn(g_params: Pytree, d_params: Pytree, bn: Pytree,
-                  z: jax.Array, labels, aug_key=None) -> Tuple[jax.Array,
-                                                               Tuple]:
+                  z: jax.Array, labels, aug_key=None,
+                  return_fake: bool = False) -> Tuple[jax.Array, Tuple]:
         fake, g_bn = generator_apply(g_params, bn["gen"], z, cfg=mcfg,
                                      train=True, labels=labels,
                                      axis_name=axis_name, attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
@@ -301,6 +395,12 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         # d-side outputs are DCE'd by XLA). BCE: non-saturating generator
         # loss (image_train.py:96).
         g_loss = gan_losses(fake_logits, fake_logits)[3]
+        # return_fake (pipelined g_update only): ride the already-computed
+        # fake out through the aux so the stage can hand it to the NEXT
+        # step's d_update — a Python-level branch, so the fused path's
+        # jaxpr is untouched
+        if return_fake:
+            return g_loss, (g_bn, fake)
         return g_loss, (g_bn,)
 
     def _ema_update(state: Pytree, new_gen: Pytree) -> Pytree:
@@ -328,41 +428,20 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         mean, at one microbatch's activation memory throughout.
         """
         K = cfg.grad_accum
-        micro = images.shape[0] // K
         params, bn = state["params"], state["bn"]
 
-        def _cm(x):
-            return constrain_micro(x) if constrain_micro is not None else x
-
-        def _split(x):
-            return _cm(x.reshape(K, micro, *x.shape[1:]))
-
-        imgs_s = _split(images)
-        lbls_s = _split(labels) if labels is not None else None
+        imgs_s = _split_micro(images)
+        lbls_s = _split_micro(labels) if labels is not None else None
 
         def _micro_xs(z_full, gpk, augk):
             """One optimizer update's worth of per-microbatch scan inputs."""
-            xs = {"img": imgs_s, "z": _split(z_full),
+            xs = {"img": imgs_s, "z": _split_micro(z_full),
                   "gpk": jax.random.split(gpk, K)}
             if lbls_s is not None:
                 xs["lbl"] = lbls_s
             if augk is not None:
                 xs["augk"] = jax.random.split(augk, K)
             return xs
-
-        def _zeros_f32(tree):
-            # accumulate in f32 whatever the param dtype: K bf16 adds would
-            # round away low-magnitude contributions
-            return jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), tree)
-
-        def _acc(acc, grads):
-            return jax.tree_util.tree_map(
-                lambda a, g: a + g.astype(jnp.float32), acc, grads)
-
-        def _avg(acc, like):
-            return _pmean(jax.tree_util.tree_map(
-                lambda a, p: (a / K).astype(p.dtype), acc, like))
 
         # --- D: each Adam apply from K accumulated microbatch grads ---------
         def d_accum_update(d_params, d_opt_state, bn_d_start, xs):
@@ -534,6 +613,212 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         new_state["ema_gen"] = _ema_update(state, new_gen)
         return new_state, _loss_metrics(d_loss, d_real, d_fake, g_loss, gp)
 
+    # --- pipelined stage programs (ISSUE 7) --------------------------------
+    # The fused step factored into three independently-dispatchable
+    # programs with IDENTICAL loss/penalty/accumulation code paths (every
+    # loss goes through _d_loss_on_fake / g_loss_fn above — the stage
+    # surfaces cannot drift from the fused ones). Unconditional models
+    # only (labels=None throughout; TrainConfig validation enforces it),
+    # sequential update_mode only (the trainer dispatches g_update after
+    # d_update, so G trains against the updated critic — the fused
+    # sequential ordering).
+
+    stage_batch = local_batch if local_batch is not None else cfg.batch_size
+
+    def _fake_stack(g_params: Pytree, g_bn: Pytree, key: jax.Array,
+                    n: int) -> jax.Array:
+        """[n, B, H, W, C] generator batches from FIXED (params, bn) —
+        fresh z per slot (the fused critic loop's per-iteration z
+        semantics via _critic_streams), train-mode BN with the updates
+        discarded (the fused D branch's convention), constrain_fake
+        applied. lax.scan so the body compiles once whatever n is."""
+        def one(carry, iter_key):
+            z_i, _, _ = _critic_streams(iter_key, stage_batch)
+            fake, _ = generator_apply(g_params, g_bn, z_i, cfg=mcfg,
+                                      train=True, labels=None,
+                                      axis_name=axis_name,
+                                      attn_mesh=attn_mesh,
+                                      pallas_mesh=pallas_mesh)
+            return carry, _cf(fake)
+        keys = jax.random.split(key, n)
+        if n == 1:
+            # no 1-trip scan (see d_update: a single-iteration while loop
+            # serializes the CPU backend)
+            return one((), keys[0])[1][None]
+        _, stack = lax.scan(one, (), keys)
+        return stack
+
+    # Every stage folds a stage-unique tag into the per-step key INSIDE
+    # its traced body, so the three stage streams are independent while
+    # the trainer hands all three the same key (the fused step splits its
+    # single key inside its program the same way). Folding here instead
+    # of in the dispatch loop matters: a host-side fold_in is a tiny
+    # device program per call — three extra per-step dispatches that
+    # stretch the pipelined span on dispatch-bound hosts.
+    _D_TAG, _G_TAG, _FILL_TAG = 0, 1, 2
+
+    def gen_fakes(state: Pytree, key: jax.Array) -> jax.Array:
+        """The FILL program: an [n_critic, B, ...] fake stack from the
+        CURRENT generator — dispatched at run start, after a restore, and
+        after a rollback invalidated the in-flight buffer."""
+        return _fake_stack(state["params"]["gen"], state["bn"]["gen"],
+                           jax.random.fold_in(key, _FILL_TAG),
+                           cfg.n_critic)
+
+    def d_update(state: Pytree, images: jax.Array, fakes: jax.Array,
+                 key: jax.Array) -> Tuple[Pytree, dict]:
+        """The critic update(s) CONSUMING a provided fake stack (slot i
+        feeds critic iteration i) instead of regenerating it — the fake
+        production moves to g_update (where the G-loss forward doubles as
+        slot 0), which is what makes this program's peak temp memory the
+        pipeline's headroom win and decouples D's fake source from G's z.
+        Touches ONLY the disc half of the state; gen/ema_gen/step ride
+        through untouched, so the tree shape is exactly the fused
+        step's."""
+        params, bn = state["params"], state["bn"]
+        iter_keys = jax.random.split(jax.random.fold_in(key, _D_TAG),
+                                     cfg.n_critic)
+        zero = _zero_metric()
+
+        if cfg.grad_accum > 1:
+            imgs_s = _split_micro(images)
+
+            def critic_iter(carry, xs):
+                d_params_c, d_opt_c, d_bn_c, _ = carry
+                fake_i, iter_key = xs
+                _, gpk, aug_k = _critic_streams(iter_key, stage_batch)
+                xs_m = {"img": imgs_s, "fake": _split_micro(fake_i),
+                        "gpk": jax.random.split(gpk, cfg.grad_accum)}
+                if aug_k is not None:
+                    xs_m["augk"] = jax.random.split(aug_k, cfg.grad_accum)
+
+                def d_micro(c, x):
+                    g_acc, bn_d = c
+                    bn_in = {"gen": bn["gen"], "disc": bn_d}
+                    (loss, (bn_i, real, fk, gp)), grads = \
+                        jax.value_and_grad(_d_loss_on_fake, has_aux=True)(
+                            d_params_c, bn_in, x["img"], x["fake"],
+                            x["gpk"], None, state["step"], False,
+                            x.get("augk"))
+                    return ((_acc(g_acc, grads), bn_i),
+                            (loss, real, fk, gp))
+
+                (g_acc, bn_d), ms = lax.scan(
+                    d_micro, (_zeros_f32(d_params_c), d_bn_c), xs_m)
+                updates, d_opt_c = opt_d.update(
+                    _avg(g_acc, d_params_c), d_opt_c, d_params_c)
+                return ((optax.apply_updates(d_params_c, updates),
+                         d_opt_c, bn_d, tuple(m.mean() for m in ms)), None)
+        else:
+            def critic_iter(carry, xs):
+                d_params_c, d_opt_c, d_bn_c, _ = carry
+                fake_i, iter_key = xs
+                _, gpk, aug_k = _critic_streams(iter_key, stage_batch)
+                bn_in = {"gen": bn["gen"], "disc": d_bn_c}
+                (loss_i, (bn_i, real_i, fake_m, gp_i)), grads = \
+                    jax.value_and_grad(_d_loss_on_fake, has_aux=True)(
+                        d_params_c, bn_in, images, fake_i, gpk, None,
+                        state["step"], False, aug_k)
+                grads = _pmean(grads)
+                updates, d_opt_c = opt_d.update(grads, d_opt_c, d_params_c)
+                return ((optax.apply_updates(d_params_c, updates),
+                         d_opt_c, bn_i,
+                         (loss_i, real_i, fake_m, gp_i)), None)
+
+        carry0 = (params["disc"], state["opt"]["disc"], bn["disc"],
+                  (zero, zero, zero, zero))
+        if cfg.n_critic == 1:
+            # direct call, no 1-trip scan — the fused step's own
+            # n_critic==1 branch skips the scan too (a single-iteration
+            # while loop measurably serializes the CPU backend), and the
+            # SAME critic_iter body runs either way so the two paths
+            # cannot drift
+            (new_disc, d_opt, d_bn,
+             (d_loss, d_real, d_fake, gp)), _ = critic_iter(
+                carry0, (fakes[0], iter_keys[0]))
+        else:
+            (new_disc, d_opt, d_bn,
+             (d_loss, d_real, d_fake, gp)), _ = lax.scan(
+                critic_iter, carry0, (fakes, iter_keys))
+        new_state = {
+            "params": {"gen": params["gen"], "disc": new_disc},
+            "bn": {"gen": bn["gen"], "disc": d_bn},
+            "opt": {"gen": state["opt"]["gen"], "disc": d_opt},
+            "ema_gen": state["ema_gen"],
+            "step": state["step"],
+        }
+        return new_state, _d_metrics(d_loss, d_real, d_fake, gp)
+
+    def g_update(state: Pytree, key: jax.Array
+                 ) -> Tuple[Pytree, jax.Array, dict]:
+        """The generator update against the CURRENT critic (the trainer
+        dispatches it after d_update — sequential semantics), RETURNING
+        the fake stack the next step's d_update consumes at staleness 1.
+        Slot 0 is the g-loss forward's own fake (from the PRE-update
+        weights — computed anyway, so the steady-state step gets its next
+        D input for free); n_critic > 1 generates the remaining slots
+        with fresh z from the same pre-update weights. Increments
+        state["step"]."""
+        key = jax.random.fold_in(key, _G_TAG)
+        if aug_policy:
+            z_key, extra_key, aug_key = jax.random.split(key, 3)
+        else:
+            z_key, extra_key = jax.random.split(key)
+            aug_key = None
+        params, bn = state["params"], state["bn"]
+
+        if cfg.grad_accum > 1:
+            z = jax.random.uniform(z_key, (stage_batch, mcfg.z_dim),
+                                   minval=-1.0, maxval=1.0,
+                                   dtype=jnp.float32)
+            xs = {"z": _split_micro(z)}
+            if aug_key is not None:
+                xs["augk"] = jax.random.split(aug_key, cfg.grad_accum)
+
+            def g_micro(carry, x):
+                g_acc, bn_g = carry
+                bn_in = {"gen": bn_g, "disc": bn["disc"]}
+                (g_loss_i, (g_bn_i, fake_i)), grads = \
+                    jax.value_and_grad(g_loss_fn, has_aux=True)(
+                        params["gen"], params["disc"], bn_in, x["z"],
+                        None, x.get("augk"), return_fake=True)
+                return (_acc(g_acc, grads), g_bn_i), (g_loss_i, fake_i)
+
+            (g_gacc, g_bn), (g_losses, fakes_m) = lax.scan(
+                g_micro, (_zeros_f32(params["gen"]), bn["gen"]), xs)
+            g_grads = _avg(g_gacc, params["gen"])
+            g_loss = g_losses.mean()
+            # (K, micro, ...) -> (B, ...): the full-batch fake the next
+            # d_update re-splits into its own microbatches
+            fake = _cf(fakes_m.reshape(stage_batch, *fakes_m.shape[2:]))
+        else:
+            z = jax.random.uniform(z_key, (stage_batch, mcfg.z_dim),
+                                   minval=-1.0, maxval=1.0,
+                                   dtype=jnp.float32)
+            (g_loss, (g_bn, fake)), g_grads = jax.value_and_grad(
+                g_loss_fn, has_aux=True)(
+                    params["gen"], params["disc"], bn, z, None, aug_key,
+                    return_fake=True)
+            g_grads = _pmean(g_grads)
+        g_updates, g_opt = opt_g.update(g_grads, state["opt"]["gen"],
+                                        params["gen"])
+        new_gen = optax.apply_updates(params["gen"], g_updates)
+
+        if cfg.n_critic > 1:
+            extra = _fake_stack(params["gen"], bn["gen"], extra_key,
+                                cfg.n_critic - 1)
+            fakes = jnp.concatenate([fake[None], extra], axis=0)
+        else:
+            fakes = fake[None]
+        new_state = {
+            "params": {"gen": new_gen, "disc": params["disc"]},
+            "bn": {"gen": g_bn, "disc": bn["disc"]},
+            "opt": {"gen": g_opt, "disc": state["opt"]["disc"]},
+            "step": state["step"] + 1,
+        }
+        new_state["ema_gen"] = _ema_update(state, new_gen)
+        return new_state, fakes, {"g_loss": _pmean(g_loss)}
+
     def sample(state: Pytree, z: jax.Array,
                labels: Optional[jax.Array] = None) -> jax.Array:
         # EMA weights when tracking is on (g_ema_decay > 0); the reference
@@ -607,4 +892,6 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         return init_train_state(key, cfg)
 
     return TrainStepFns(train_step=train_step, sample=sample, init=init,
-                        summarize=summarize, eval_losses=eval_losses)
+                        summarize=summarize, eval_losses=eval_losses,
+                        gen_fakes=gen_fakes, d_update=d_update,
+                        g_update=g_update)

@@ -74,6 +74,7 @@ from dcgan_tpu.parallel import (
 from dcgan_tpu.testing import chaos
 from dcgan_tpu.train import coordination, warmup
 from dcgan_tpu.train.flight_recorder import FlightRecorder, recorder_path
+from dcgan_tpu.train.gd_pipeline import GDPipeline
 from dcgan_tpu.train.rollback import RollbackManager
 from dcgan_tpu.train.services import make_services
 from dcgan_tpu.utils.checkpoint import Checkpointer
@@ -369,6 +370,15 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         mesh = make_mesh(cfg.mesh)
         pt = make_parallel_train(cfg, mesh)
     chief = is_chief()
+    # Pipelined G/D dispatch (ISSUE 7, DESIGN.md §6f): the step runs as
+    # three stage programs with the D step consuming the fake stack
+    # produced during the PREVIOUS step (staleness 1). The stack lives in
+    # this trainer-held buffer, OUTSIDE the checkpoint pytree — both modes
+    # save/restore the identical state tree. None under the default fused
+    # mode: every pipeline branch below is strictly opt-in, so the
+    # default-flags dispatch stream and event values are untouched (the
+    # parity contract).
+    pipeline = GDPipeline() if cfg.pipeline_gd else None
     # the quarantine tally is process-global (it spans both loader
     # implementations and the train+sample pipelines); this run reports its
     # own delta — captured BEFORE any loader thread starts — so counts from
@@ -669,7 +679,7 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         spc = max(ks) if ks else max(1, cfg.steps_per_call)
         import socket
 
-        from dcgan_tpu.utils.trace import digest, find_trace
+        from dcgan_tpu.utils.trace import digest, find_trace, stage_step_ms
         try:
             trace_path = find_trace(trace_dir, host=socket.gethostname())
         except OSError as e:
@@ -683,6 +693,15 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 print(f"[dcgan_tpu] trace capture ending at step {s} has "
                       "no device events; nothing to digest", flush=True)
                 return
+            step_ms = d["program_ms_median"] / spc
+            if cfg.pipeline_gd:
+                # pipelined dispatch (ISSUE 7): one trainer step is the
+                # d_update AND g_update executions — the busiest-program
+                # median alone would report roughly half a step. Sum the
+                # stage medians when the track names the stage programs
+                # (TPU module tracks do; the CPU op-level fallback keeps
+                # the busiest-program estimate).
+                step_ms = stage_step_ms(d) or step_ms
             row = {
                 "perf/device/compute_ms": d["compute_ms"],
                 "perf/device/collective_ms": d["collective_ms"],
@@ -690,8 +709,8 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 "perf/device/span_ms": d["span_ms"],
                 # the device's own per-step time: the busiest program's
                 # median execution, normalized for scanned multi-step
-                # dispatch
-                "perf/device/step_ms": d["program_ms_median"] / spc,
+                # dispatch (stage-summed under --pipeline_gd)
+                "perf/device/step_ms": step_ms,
             }
             print(f"[dcgan_tpu] trace digest (ending step {s}, "
                   f"{d['source']} track, top program {d['program']!r} "
@@ -746,6 +765,24 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         """A watchdog guard that is a free no-op until the mesh is warm."""
         return watchdog.guard(phase, step) if mesh_warm \
             else coordination.NULL_GUARD
+
+    if rollback is not None and pipeline is not None:
+        # Drain-before-restore (ISSUE 7): the in-flight fake stack was
+        # generated by the diverged weights the rollback is fleeing — it
+        # must never train the restored state, and its device memory must
+        # be free before the restore copies allocate. Parked on the
+        # manager's restore hook (structurally tied to restore(), so no
+        # call site can forget it); the nested guard names the phase if a
+        # drain-window hang trips the watchdog, then hands the deadline
+        # back to the enclosing rollback-restore arm.
+        def _drain_for_restore():
+            with _guard("pipeline-drain", step_num):
+                if pipeline.drain("rollback") and chief:
+                    print("[dcgan_tpu] rollback drained the in-flight "
+                          "pipelined fake stack (stale generator output; "
+                          "refilled from the restored state at the next "
+                          "dispatch)", flush=True)
+        rollback.on_restore = _drain_for_restore
 
     def _stage(tree) -> None:
         """Start D2H copies of a dispatched program's outputs now, so the
@@ -857,12 +894,19 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         if not flight.enabled:
             return
         host = p.get("host")
-        flight.record({
+        rec = {
             "step": p["step"], "time": time.time(), "gate": gate,
             "step_ms": timer.last_step_ms, "host_ms": timer.last_host_ms,
             "metrics": dict(host) if host else None,
             "counters": registry.snapshot().as_dict(),
-        })
+        }
+        if "pipeline" in p:
+            # --pipeline_gd only (ISSUE 7): which pipeline phase this step
+            # dispatched under ("fill"/"steady") — a crash dump from a
+            # mid-fill or mid-drain hang must say so; absent in fused mode
+            # so default dumps are unchanged
+            rec["pipeline"] = p["pipeline"]
+        flight.record(rec)
 
     def _nan_gate(p: dict, *, force: bool = False) -> bool:
         """Numerical-health gate (SURVEY.md §5) with anomaly CONSENSUS
@@ -1071,6 +1115,13 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 # saved — crash-path-only IO, so parity holds
                 flight.dump("coordinated-stop", step=step_num,
                             extra={"signal": int(stop_sig)})
+                if pipeline is not None:
+                    # release the in-flight fake stack before the final
+                    # collective save allocates (ISSUE 7) — the stop
+                    # decision is consensus-agreed, so every process
+                    # drains at the same boundary
+                    with _guard("pipeline-drain", step_num):
+                        pipeline.drain("coordinated-stop")
                 # drain the services queue BEFORE the final save below: the
                 # emergency checkpoint must not outrun queued JSONL/TB
                 # events, or a post-stop inspection sees a stream truncated
@@ -1095,7 +1146,16 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
             # ITS first compile) — compile time is legitimate and
             # unbounded by this knob
             if mesh_warm and k in compiled_ks:
-                watchdog.arm("step-dispatch", step_num)
+                # stage-resolved phase labels under --pipeline_gd (ISSUE 7):
+                # a trip inside the refill after a rollback reads
+                # "pipeline-fill", a steady-state trip "pipeline-dispatch"
+                # — the fused path keeps its historical label
+                if pipeline is None:
+                    phase = "step-dispatch"
+                else:
+                    phase = "pipeline-dispatch" if pipeline.primed \
+                        else "pipeline-fill"
+                watchdog.arm(phase, step_num)
             chaos.maybe_hang(step_num)  # drill: a peer that goes silent
             trace.maybe_start(step_num)
             if trace.active:
@@ -1106,6 +1166,15 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 if conditional:
                     images, labels = next(data)
                     state, metrics = pt.step(state, images, key, labels)
+                elif pipeline is not None:
+                    # pipelined dispatch (ISSUE 7): d_update consumes the
+                    # stack g_update produced during the previous step;
+                    # an unprimed buffer (run start, post-rollback, post-
+                    # drain) dispatches the gen_fakes fill first — the
+                    # watchdog phase armed above names which case a hang
+                    # died in
+                    images = next(data)
+                    state, metrics = pipeline.step(pt, state, images, key)
                 else:
                     images = next(data)
                     state, metrics = pt.step(state, images, key)
@@ -1133,6 +1202,11 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
             new_step = step_num + k
             cur = {"step": new_step, "metrics": metrics,
                    "write_scalars": False}
+            if pipeline is not None:
+                # the step's pipeline phase rides the record so the flight
+                # recorder can stamp it (fill vs steady), lag-by-one safe —
+                # the tag is captured at dispatch, consumed whenever
+                cur["pipeline"] = pipeline.last_phase
 
             host_t0 = time.perf_counter()
             if deferred:
@@ -1451,6 +1525,10 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         # below still wants its deadline) so a fast abort path cannot race
         # a stale deadline into a spurious process exit during cleanup.
         watchdog.disarm()
+        if pipeline is not None:
+            # release the buffer on every exit path (normal completion,
+            # abort, loader error) — nothing past the loop consumes it
+            pipeline.drain("shutdown")
         for closing in (svc, data, sample_data, fid_probe_data):
             if closing is None or not hasattr(closing, "close"):
                 continue

@@ -246,9 +246,23 @@ def _program_args(cfg, pt, state, *, sample_z=None, sample_labels=None,
             sharding=jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec(None, *sds.sharding.spec)))
 
-    programs: List[Tuple[str, Callable, tuple]] = [
-        ("train_step", pt.programs["train_step"],
-         (state, img, key) + lbls),
+    if cfg.pipeline_gd:
+        # pipelined dispatch (ISSUE 7): the loop runs the three stage
+        # programs, never the fused step — warm exactly what it dispatches.
+        # The fake stack example arg is a ShapeDtypeStruct with the
+        # slot-axis-in-front scan sharding (batch on axis 1), the shape
+        # gen_fakes/g_update produce and d_update consumes.
+        fakes = _scan_sds(img, cfg.n_critic)
+        step_programs: List[Tuple[str, Callable, tuple]] = [
+            ("gen_fakes", pt.programs["gen_fakes"], (state, key)),
+            ("d_update", pt.programs["d_update"],
+             (state, img, fakes, key)),
+            ("g_update", pt.programs["g_update"], (state, key)),
+        ]
+    else:
+        step_programs = [("train_step", pt.programs["train_step"],
+                          (state, img, key) + lbls)]
+    programs: List[Tuple[str, Callable, tuple]] = step_programs + [
         # the state-tree identity copy: the program behind BOTH the
         # checkpoint restore's buffer rebase (utils/checkpoint.py) and the
         # rollback device-resident snapshot (train/rollback.device_copy) —
@@ -298,8 +312,12 @@ def build_warmup_plan(cfg, pt, state, *, sample_z=None, sample_labels=None,
                 cfg, pt_backoff, state, sample_z=sample_z,
                 sample_labels=sample_labels, eval_z=eval_z):
             # only the step programs rebuild on rollback; sampler/probe/
-            # summarize are LR-independent (identical HLO, already planned)
-            if name.startswith(("train_step", "multi_step")):
+            # summarize are LR-independent (identical HLO, already planned).
+            # Under --pipeline_gd the step programs are the d_update/
+            # g_update stages (optimizer constants bake the LR in);
+            # gen_fakes is LR-independent like the sampler
+            if name.startswith(("train_step", "multi_step",
+                                "d_update", "g_update")):
                 plan.append((f"{name}@lr_backoff", fn, args))
     return plan, pt_backoff
 

@@ -19,12 +19,16 @@ Track selection, in preference order:
   the ``Steps`` thread's spans cover the whole timeline (they would report
   zero idle) and the ``XLA Ops`` thread is per-op; ops are consulted only
   for collective attribution (collectives are op-named, not module-named).
-- else the busiest XLA-executor THREAD track (thread_name matching
-  ``XLA``, e.g. ``tf_XLATfrtCpuClient/...``) — where CPU captures put op
-  execution. Thread granularity matters: the CPU ``python`` thread carries
-  whole-call tracing spans (PjitFunction, profiler frames) that cover the
-  timeline and would report zero idle.
-- else the busiest non-``python`` thread track of any pid.
+- else the busiest XLA-executor thread GROUP (thread_name matching
+  ``XLA``, grouped by the pool prefix before ``/`` — e.g. all
+  ``tf_XLAEigen/<id>`` threads together), spans merged across the
+  group's threads and client ``(wait for …)`` spans excluded — where CPU
+  captures put op execution. Group granularity matters twice over: the
+  CPU ``python`` thread carries whole-call tracing spans (PjitFunction,
+  profiler frames) that cover the timeline and would report zero idle,
+  and a single-thread pick undercounts captures whose programs spread
+  across a pool's threads (the pipelined G/D stage programs do).
+- else the busiest non-``python`` thread group of any pid.
 - else: no device events (`source == "none"`); callers decide (the CLI
   tool exits nonzero with a usage hint — a silent empty report looked like
   a healthy parse, satellite fix).
@@ -116,23 +120,45 @@ def select_device_tracks(events: List[dict]
             programs = [e for e in dev if "Steps" not in tname(e)] or dev
         ops = [e for e in dev if "XLA Ops" in tname(e)] or programs
         return programs, ops, "tpu"
-    by_track: Dict[Tuple[Any, Any], float] = {}
+    # CPU fallbacks select the busiest thread GROUP, not the busiest
+    # single thread: executor pools name their threads "<pool>/<id>"
+    # (tf_XLAEigen/…, tf_XLATfrtCpuClient/…) and a capture whose programs
+    # spread across a pool's threads — the pipelined G/D stage programs
+    # do exactly that — would have roughly half its busy time invisible
+    # to a single-thread pick, inflating idle_gap_ms as a measurement
+    # artifact. Spans are merged across the group's threads (the union
+    # accounting below already handles the overlap). Client-side
+    # "… (wait for …)" spans are excluded BEFORE selection and
+    # accounting: they are the executor *waiting* on work, and counting
+    # them as busy would both crown the wait-dominated client group and
+    # report near-zero idle on any capture.
+    def _group(key):
+        # pid stays in the key: two processes may each run a same-named
+        # pool (or unnamed threads, prefix ""), and merging across pids
+        # would mix unrelated timelines into one pseudo-track
+        return (key[0], tnames.get(key, "").split("/")[0])
+
+    by_group: Dict[Tuple[Any, str], float] = {}
     for e in xs:
-        key = (e["pid"], e.get("tid"))
-        by_track[key] = by_track.get(key, 0.0) + e["dur"]
+        if "wait" in e["name"].lower():
+            continue
+        g = _group((e["pid"], e.get("tid")))
+        by_group[g] = by_group.get(g, 0.0) + e["dur"]
 
-    def busiest(keys):
-        return max(keys, key=lambda k: by_track[k], default=None)
+    def busiest(groups):
+        return max(groups, key=lambda g: by_group[g], default=None)
 
-    xla = busiest([k for k in by_track if "XLA" in tnames.get(k, "")])
+    xla = busiest([g for g in by_group if "XLA" in g[1]])
     if xla is not None:
-        track, source = xla, "xla-thread"
+        group, source = xla, "xla-thread"
     else:
-        track = busiest([k for k in by_track
-                         if "python" not in tnames.get(k, "").lower()]) \
-            or busiest(by_track)
+        group = busiest([g for g in by_group
+                         if "python" not in g[1].lower()])
+        if group is None:   # explicit None check — an unnamed-thread
+            group = busiest(by_group)  # group (pid, "") is a valid pick
         source = "busiest-thread"
-    picked = [e for e in xs if (e["pid"], e.get("tid")) == track]
+    picked = [e for e in xs if _group((e["pid"], e.get("tid"))) == group
+              and "wait" not in e["name"].lower()]
     return picked, picked, source
 
 
@@ -188,6 +214,20 @@ def devstep_ms(path: str, per_exec: int = 1):
     if d["source"] == "none" or d["program_ms_median"] <= 0:
         return None
     return d["program_ms_median"] / max(1, per_exec)
+
+
+def stage_step_ms(d: dict,
+                  stages: Tuple[str, ...] = ("d_update", "g_update")
+                  ) -> float:
+    """Per-step device ms when the step was dispatched as separable stage
+    programs (--pipeline_gd, ISSUE 7): the sum of the named stages' median
+    executions — the busiest-program median alone would report roughly
+    half a step there. 0.0 when the capture's track doesn't name the
+    stage programs (the CPU op-level fallback) — callers keep their
+    busiest-program estimate. One definition shared by the trainer's
+    perf/device/step_ms and bench.py's pipelined A/B arm."""
+    return sum(r["ms_median"] for r in d.get("rows", [])
+               if any(s in r["program"] for s in stages))
 
 
 def digest(trace_path: str) -> dict:

@@ -401,6 +401,39 @@ class TestObservabilitySmoke:
 
 
 @pytest.mark.chaos
+class TestPipelineRollbackSmoke:
+    """ISSUE 7's tier-1 pin (chaos-marker pattern): a NaN fault under
+    --pipeline_gd must drain the in-flight fake stack at the rollback,
+    refill from the restored state, complete, and replay bit-exactly —
+    through real trainer subprocesses, inside an explicit runtime budget.
+    The full matrix runs standalone:
+    `JAX_PLATFORMS=cpu python tools/chaos_drill.py`."""
+
+    def test_pipeline_rollback_within_budget(self):
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "tools/chaos_drill.py", "--only",
+             "pipeline-rollback"],
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        elapsed = time.monotonic() - t0
+        lines = [json.loads(l) for l in res.stdout.splitlines()
+                 if l.startswith("{")]
+        summary = lines[-1]
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
+        assert summary["scenarios"] == 1 and summary["failed"] == 0
+        scenarios = {p["scenario"]: p for p in lines if "scenario" in p}
+        assert set(scenarios) == {"pipeline-rollback"}
+        assert scenarios["pipeline-rollback"]["rollbacks"] >= 1
+        assert scenarios["pipeline-rollback"]["replay_bit_exact"] is True
+        # two tiny trainer subprocesses (the replay pair, ~20 s each on a
+        # quiet host, compile-dominated); ~4x headroom for CI contention
+        assert elapsed < 300, f"pipeline-rollback smoke took {elapsed:.0f}s"
+
+
+@pytest.mark.chaos
 class TestBenchStartupSmoke:
     """tools/bench_startup.py --smoke pinned into tier-1 (ISSUE 5,
     mirroring the chaos_drill pattern): the cold-vs-warm trainer A/B must

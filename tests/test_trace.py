@@ -103,6 +103,41 @@ class TestSyntheticTraces:
         assert source == "xla-thread"
         assert rows[0]["program"] == "dot.3" and rows[0]["n"] == 2
 
+    def test_xla_fallback_merges_the_thread_group(self, tmp_path):
+        """Executor pools name threads '<pool>/<id>'; a capture whose
+        programs spread across a pool's threads (the pipelined G/D stage
+        dispatch does) must account the WHOLE group — a busiest-single-
+        thread pick would leave roughly half the busy time invisible and
+        inflate idle_gap_ms as a measurement artifact (ISSUE 7)."""
+        ev = [meta(7, "/host:CPU"),
+              meta(7, "tf_XLAEigen/111", tid=1),
+              meta(7, "tf_XLAEigen/222", tid=2),
+              span(7, 1, "d_update", 0, 400),
+              span(7, 2, "g_update", 500, 400),
+              span(7, 1, "d_update", 1000, 400)]
+        d = digest(write_trace(tmp_path / "t.json.gz", ev))
+        assert d["source"] == "xla-thread"
+        # all three executions counted: 1.2 ms busy over a 1.4 ms span
+        assert d["compute_ms"] == pytest.approx(1.2)
+        assert d["idle_gap_ms"] == pytest.approx(0.2)
+        assert {r["program"] for r in d["rows"]} == {"d_update", "g_update"}
+
+    def test_xla_fallback_excludes_wait_spans(self, tmp_path):
+        """Client '(wait for …)' spans are the executor WAITING, not
+        executing: they must neither crown the wait-dominated client
+        group during selection nor count as busy time."""
+        ev = [meta(7, "/host:CPU"),
+              meta(7, "tf_XLATfrtCpuClient/1", tid=1),
+              meta(7, "tf_XLAEigen/1", tid=2),
+              span(7, 1, "ThunkExecutor::Execute (wait for ready)",
+                   0, 10000),
+              span(7, 2, "conv.1", 0, 300),
+              span(7, 2, "conv.1", 600, 300)]
+        d = digest(write_trace(tmp_path / "t.json.gz", ev))
+        assert d["source"] == "xla-thread"
+        assert d["program"] == "conv.1" and d["program_n"] == 2
+        assert d["compute_ms"] == pytest.approx(0.6)
+
     def test_busiest_nonpython_fallback(self, tmp_path):
         ev = [meta(7, "/host:CPU"),
               meta(7, "python", tid=1), meta(7, "worker", tid=2),

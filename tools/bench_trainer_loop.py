@@ -30,6 +30,12 @@ so the dispatch-thread overlap win is a recorded number, not a claim:
    "services_on":  {"dispatch_occupancy": ..., "step_ms_mean": ...},
    "services_off": {"dispatch_occupancy": ..., "step_ms_mean": ...}, ...}
 
+TRAINER_BENCH_PIPELINE=1 switches to the pipelined-G/D A/B mode (ISSUE 7):
+the same trainer runs twice — --pipeline_gd=false then =true — with a
+mid-run trace window each, and the row reports both arms' recorded
+perf/device/{step_ms,idle_gap_ms} digests and host occupancy (see
+_pipeline_mode).
+
 Workload anchor: the hot loop being replaced, image_train.py:147-194.
 """
 
@@ -38,6 +44,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import shlex
 import subprocess
 import sys
 import tempfile
@@ -115,6 +122,153 @@ def _occupancy_mode() -> None:
                 "images_per_sec": round(perf.get("perf/images_per_sec", 0.0),
                                         1),
             }
+    print(json.dumps(row))
+
+
+def _pipeline_run(repo: str, flag: str, *, steps: int, trace_steps: int,
+                  batch: str) -> dict:
+    """One A/B arm: a trainer subprocess with a mid-run scheduled trace
+    window, returning the arm's recorded perf + device-digest fields."""
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = os.path.join(tmp, "ckpt")
+        argv = [
+            sys.executable, "-m", "dcgan_tpu.train",
+            "--synthetic",
+            "--synthetic_device_cache",
+            os.environ.get("TRAINER_BENCH_CACHE", "8"),
+            "--max_steps", str(steps),
+            "--batch_size", batch,
+            "--pipeline_gd", flag,
+            # the pipelined mode's dispatch shape; the fused arm runs
+            # the same so the A/B isolates the stage split, not scan
+            # amortization (that regime is the main trainer-loop row)
+            "--steps_per_call", "1",
+            # value syncs OUT of the trace window (cadences past
+            # max_steps): the window measures the steady dispatch stream,
+            # not readback stalls — which hit both arms but add variance
+            "--log_every_steps",
+            os.environ.get("TRAINER_BENCH_LOG", str(steps * 2)),
+            "--nan_check_steps", str(steps * 2),
+            # one summary tick fires immediately (warmup) and the next
+            # lands near end-of-run — the last perf row is steady-state
+            # and the mid-run window stays summary-free on CPU smoke
+            # timings; the median-of-reps absorbs a straggler tick
+            "--save_summaries_secs",
+            os.environ.get("TRAINER_BENCH_SUMMARY_SECS", "4"),
+            "--sample_every_steps", "0",
+            "--activation_summary_steps", "0",
+            "--save_model_secs", "1e9",
+            "--no_tensorboard",
+            # mid-run scheduled window: past compile, the fill, and the
+            # occupancy-timer warmup
+            "--profile_dir", os.path.join(tmp, "trace"),
+            "--profile_start_step", str(max(1, steps // 2)),
+            "--profile_num_steps", str(trace_steps),
+            "--checkpoint_dir", ckpt,
+            "--sample_dir", os.path.join(tmp, "samples"),
+        ]
+        # extra trainer flags for smoke runs (e.g. a tiny model:
+        # "--output_size 16 --gf_dim 8 --df_dim 8" — the flagship
+        # 64x64 model runs ~10 s/step on a CPU test host)
+        argv += shlex.split(os.environ.get("TRAINER_BENCH_EXTRA", ""))
+        res = subprocess.run(
+            argv, cwd=repo, capture_output=True, text=True,
+            timeout=float(os.environ.get("TRAINER_BENCH_TIMEOUT", 900)))
+        if res.returncode != 0:
+            raise RuntimeError(f"trainer rc={res.returncode}: "
+                               f"{(res.stderr or '')[-300:]}")
+        perf, device = None, None
+        with open(os.path.join(ckpt, "events.jsonl")) as f:
+            for line in f:
+                e = json.loads(line)
+                if e["kind"] != "scalars":
+                    continue
+                if "perf/dispatch_occupancy" in e["values"]:
+                    perf = e["values"]
+                if "perf/device/step_ms" in e["values"]:
+                    device = e["values"]
+        if perf is None or device is None:
+            raise RuntimeError(
+                f"no {'perf' if perf is None else 'device'} scalars "
+                "in events.jsonl")
+        span = device["perf/device/span_ms"]
+        return {
+            "devstep_ms": device["perf/device/step_ms"],
+            "compute_ms": device["perf/device/compute_ms"],
+            "idle_gap_ms": device["perf/device/idle_gap_ms"],
+            "span_ms": span,
+            # the share of the captured window the device sat between
+            # dispatches — THE number the pipeline exists to shrink
+            "idle_share": (device["perf/device/idle_gap_ms"] / span
+                           if span > 0 else None),
+            "step_ms_mean": perf["perf/step_ms_mean"],
+            "images_per_sec": perf.get("perf/images_per_sec", 0.0),
+            "dispatch_occupancy": perf["perf/dispatch_occupancy"],
+        }
+
+
+def _pipeline_mode() -> None:
+    """A/B the pipelined G/D dispatch (ISSUE 7) against the fused step.
+
+    TRAINER_BENCH_REPS (default 3) INTERLEAVED trainer-run pairs —
+    --pipeline_gd=false then =true per rep, both at steps_per_call=1 (the
+    pipelined mode's dispatch shape) — each run with a mid-run scheduled
+    trace window. The row reports each arm's per-field MEDIAN across the
+    reps (plus the per-rep idle shares for spread): on a contended CPU
+    smoke host the per-window idle share swings several points run to
+    run, and interleaving + medians is what makes the A/B a number
+    instead of a coin flip. The fields are the trainer's OWN recorded
+    perf/device/{step_ms,idle_gap_ms,compute_ms,span_ms} digest next to
+    the host-side occupancy numbers — the same measurement path the
+    fleet runs, not a bench-only harness. Per-step FLOPs are
+    conservation-equal across the arms (tools/step_profile.py
+    PIPELINE_GD=1 proves it), so the A/B is a regression guard: the
+    device idle share of the window must not grow and devstep_ms must be
+    no worse. NOTE: on CPU test hosts the capture falls back to the
+    op-level executor thread-group track (utils/trace.py), so the device
+    fields prove the path end-to-end rather than attributing real device
+    time; the attributing numbers come from TPU module tracks.
+      {"label": "trainer-loop-pipeline",
+       "fused":     {"devstep_ms": ..., "idle_share": ..., ...},
+       "pipelined": {"devstep_ms": ..., "idle_share": ..., ...},
+       "idle_shares": {"fused": [...], "pipelined": [...]},
+       "idle_share_delta": ...}
+    """
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    steps = int(os.environ.get("TRAINER_BENCH_STEPS", 200))
+    trace_steps = int(os.environ.get("TRAINER_BENCH_TRACE_STEPS", 60))
+    reps = max(1, int(os.environ.get("TRAINER_BENCH_REPS", 3)))
+    batch = os.environ.get("BENCH_BATCH", "64")
+    row = {"label": "trainer-loop-pipeline", "batch": int(batch),
+           "total_steps": steps, "reps": reps}
+    samples = {"fused": [], "pipelined": []}
+    for rep in range(reps):
+        for arm, flag in (("fused", "false"), ("pipelined", "true")):
+            try:
+                samples[arm].append(_pipeline_run(
+                    repo, flag, steps=steps, trace_steps=trace_steps,
+                    batch=batch))
+            except (RuntimeError, OSError,
+                    subprocess.TimeoutExpired) as e:
+                print(json.dumps({**row, "error": f"{arm} rep {rep}: {e}"}))
+                sys.exit(1)
+
+    def median(vals):
+        vs = sorted(v for v in vals if v is not None)
+        return vs[len(vs) // 2] if vs else None
+
+    for arm, runs in samples.items():
+        row[arm] = {k: (round(median([r[k] for r in runs]), 4)
+                        if median([r[k] for r in runs]) is not None
+                        else None)
+                    for k in runs[0]}
+    row["idle_shares"] = {
+        arm: [round(r["idle_share"], 4) for r in runs
+              if r["idle_share"] is not None]
+        for arm, runs in samples.items()}
+    f, p = row["fused"], row["pipelined"]
+    if f["idle_share"] is not None and p["idle_share"] is not None:
+        row["idle_share_delta"] = round(p["idle_share"] - f["idle_share"], 4)
     print(json.dumps(row))
 
 
@@ -205,5 +359,7 @@ def main() -> None:
 if __name__ == "__main__":
     if os.environ.get("TRAINER_BENCH_OCCUPANCY") == "1":
         _occupancy_mode()
+    elif os.environ.get("TRAINER_BENCH_PIPELINE") == "1":
+        _pipeline_mode()
     else:
         main()

@@ -36,6 +36,12 @@ never silently trains garbage, never hangs.
     trace-trigger         (no fault) pre-touched         N-step capture +
                           --profile_trigger file         in-process digest ->
                                                          perf/device/* events
+    pipeline-rollback     NaN mid-run under              rollback drains the
+                          --pipeline_gd                  in-flight fake stack,
+                                                         refills from the
+                                                         restored state, run
+                                                         completes; replay is
+                                                         bit-exact
 
 Multi-host matrix (ISSUE 4, `--multihost`): the same contract under a REAL
 2-process jax.distributed job over localhost gRPC (tests/multihost_worker.py
@@ -102,8 +108,20 @@ cfg = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
                   save_summaries_secs=0.0, log_every_steps=1,
                   **{extra!r})
 state = train(cfg, synthetic_data={synthetic!r}, max_steps={max_steps!r})
+import numpy as np
+total = sum(float(np.abs(np.asarray(jax.device_get(leaf),
+                                    np.float64)).sum())
+            for leaf in jax.tree_util.tree_leaves(state["params"]))
+print("STATE_SUM=%.9e" % total, flush=True)
 print("TRAIN_DONE step=%d" % int(jax.device_get(state["step"])), flush=True)
 """
+
+
+def _state_sum(out: str) -> str:
+    """The driver's STATE_SUM line (full-precision text — compared for
+    bit-exact equality, never parsed back into a float)."""
+    return next(line for line in out.splitlines()
+                if line.startswith("STATE_SUM="))
 
 
 def _run_train(extra: dict, *, max_steps: int, synthetic: bool = True,
@@ -362,8 +380,51 @@ def scenario_trace_trigger(root: str) -> dict:
             "device_idle_gap_ms": round(rows[-1][keys[2]], 3)}
 
 
+def scenario_pipeline_rollback(root: str) -> dict:
+    """NaN mid-run under --pipeline_gd (ISSUE 7) -> the anomaly rollback
+    DRAINS the in-flight fake stack (generated by the diverged weights the
+    rollback is fleeing — it must never train the restored state), refills
+    from the restored generator at the next dispatch, and the run
+    completes with the same rollback protocol as fused mode. Determinism
+    is asserted the strong way: a second identical pipelined run must
+    reproduce STATE_SUM to the printed digit — the drain/refill schedule
+    is part of the deterministic replay, not a wall-clock accident. (The
+    pipelined and fused final states legitimately differ: staleness-1
+    fakes are a different — equally valid — training trajectory.)"""
+    knobs = dict(pipeline_gd=True, nan_policy="rollback", nan_check_steps=1,
+                 rollback_snapshot_steps=2, max_rollbacks=2,
+                 save_model_secs=1e9)
+
+    def one(tag):
+        ck = os.path.join(root, f"ck-{tag}")
+        rc, out = _run_train(
+            dict(checkpoint_dir=ck,
+                 sample_dir=os.path.join(root, f"sm-{tag}"), **knobs),
+            max_steps=6, chaos={"nan_at_step": 3})
+        _check(rc == 0, f"{tag}: trainer failed (rc={rc}): {out[-800:]}")
+        _check("rolling back to last-good snapshot at step 2" in out,
+               f"{tag}: no rollback message: {out[-800:]}")
+        _check("rollback drained the in-flight pipelined fake stack" in out,
+               f"{tag}: rollback did not drain the fake buffer: "
+               f"{out[-800:]}")
+        _check("TRAIN_DONE step=6" in out,
+               f"{tag}: run did not complete: {out[-400:]}")
+        rollbacks = _scalar_values(_events(ck), "anomaly/rollbacks")
+        _check(rollbacks and max(rollbacks) >= 1,
+               f"{tag}: anomaly/rollbacks missing (got {rollbacks})")
+        return _state_sum(out), max(rollbacks)
+
+    sum_a, rollbacks = one("a")
+    sum_b, _ = one("b")
+    _check(sum_a == sum_b,
+           f"pipelined rollback replay diverged: {sum_a} != {sum_b}")
+    return {"rollbacks": rollbacks, "final_step": 6,
+            "replay_bit_exact": True}
+
+
 SCENARIOS = {
     "nan-rollback": scenario_nan_rollback,
+    "pipeline-rollback": scenario_pipeline_rollback,
     "corrupt-record": scenario_corrupt_record,
     "corrupt-budget": scenario_corrupt_budget,
     "truncate-checkpoint": scenario_truncate_checkpoint,
