@@ -1,0 +1,32 @@
+"""Invariant analyzer (ISSUE 8): static AST lint + runtime tripwire.
+
+The contracts that keep seven PRs of concurrency, donation, and parity
+machinery correct live here as executable checks instead of docstring
+folklore:
+
+    DCG001  collectives only on the dispatch thread   analysis/threads.py
+    DCG002  no donating non-XLA-owned buffers         analysis/donation.py
+    DCG003  shard_map only via utils/backend shim     analysis/hygiene.py
+    DCG004  event keys declared + gated (parity)      analysis/parity.py
+    DCG005  no wall-clock/host-RNG in traced bodies   analysis/hygiene.py
+    DCG006  retry-wrapped IO in services/checkpoint   analysis/hygiene.py
+
+Surface: `python -m dcgan_tpu.analysis [--json] [--baseline FILE]
+[paths...]` — exit 1 on any non-baselined finding. Per-line suppression:
+`# dcg: disable=DCG005`. Committed exemptions: analysis/baseline.jsonl
+(every entry carries a `why`). The runtime half is analysis/tripwire.py
+(`DCGAN_THREAD_CHECKS=1`), armed across tier-1 by tests/conftest.py.
+See docs/DESIGN.md §7b for the full invariant catalog.
+"""
+
+from dcgan_tpu.analysis.core import (  # noqa: F401
+    Config,
+    Finding,
+    SourceFile,
+    collect_sources,
+    default_baseline_path,
+    default_root,
+    load_baseline,
+    run_checks,
+    split_baselined,
+)

@@ -1,0 +1,89 @@
+"""CLI: `python -m dcgan_tpu.analysis [--json] [--baseline FILE] [paths...]`.
+
+Runs the six invariant checkers over the package (or the given paths),
+applies per-line suppressions and the committed baseline, prints the
+findings, and exits 1 if any NON-baselined finding remains — the tier-1
+contract (tests/test_tools.py pins a clean run).
+
+`--write-baseline FILE` drafts baseline entries for the current findings
+(with `why` left as a TODO each entry must replace before review).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from dcgan_tpu.analysis import core
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m dcgan_tpu.analysis",
+        description="invariant analyzer: concurrency/donation/parity "
+                    "contract lint over the dcgan_tpu package")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to scan (default: the "
+                        "dcgan_tpu package)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="one JSON object per finding + a summary line")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSONL of accepted findings (default: "
+                        "dcgan_tpu/analysis/baseline.jsonl; pass '' to "
+                        "ignore the baseline)")
+    p.add_argument("--checks", nargs="+", default=None,
+                   metavar="DCGXXX", help="run only these checker IDs")
+    p.add_argument("--write-baseline", default=None, metavar="FILE",
+                   help="write the current findings as draft baseline "
+                        "entries to FILE and exit 0")
+    args = p.parse_args(argv)
+
+    root = core.default_root()
+    paths = args.paths or [os.path.join(root, "dcgan_tpu")]
+    try:  # bad path / unknown --checks ID: usage error, not a traceback
+        sources = core.collect_sources(paths, root)
+        findings = core.run_checks(sources, core.Config(),
+                                   checks=args.checks)
+    except ValueError as e:
+        p.error(str(e))
+
+    if args.write_baseline is not None:
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            for finding in findings:
+                f.write(json.dumps(finding.baseline_entry()) + "\n")
+        print(f"wrote {len(findings)} draft baseline entr"
+              f"{'y' if len(findings) == 1 else 'ies'} to "
+              f"{args.write_baseline} (fill in each 'why')")
+        return 0
+
+    baseline_path = args.baseline if args.baseline is not None \
+        else core.default_baseline_path()
+    try:  # malformed entry / draft TODO why: a clean error, not a dump
+        baseline = core.load_baseline(baseline_path) if baseline_path \
+            else []
+    except ValueError as e:
+        p.error(str(e))
+    new, old = core.split_baselined(findings, baseline)
+
+    if args.as_json:
+        for finding in new:
+            print(json.dumps(finding.to_json()))
+        print(json.dumps({
+            "label": "dcgan-analysis", "files": len(sources),
+            "findings": len(findings), "baselined": len(old),
+            "new_findings": len(new)}))
+    else:
+        for finding in new:
+            print(f"{finding.path}:{finding.line}: {finding.check} "
+                  f"[{finding.symbol}] {finding.message}")
+        print(f"[dcgan_tpu.analysis] {len(sources)} file(s), "
+              f"{len(new)} new finding(s), {len(old)} baselined"
+              + ("" if new else " — clean"))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

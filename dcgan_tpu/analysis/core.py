@@ -1,0 +1,329 @@
+"""Analyzer plumbing: findings, suppressions, baselines, the check registry.
+
+The invariant analyzer (ISSUE 8) is a plain-AST pass — no imports of the
+code under analysis, no jax — so it runs in milliseconds on every tier-1
+pass and cannot be broken by a module that fails to import. Each checker
+is a function `(sources, config) -> [Finding]` registered in `CHECKS`
+under its stable ID; this module owns everything the checkers share:
+
+- `SourceFile`: one parsed file (AST + parent links + the per-line
+  `# dcg: disable=DCGxxx` suppression map). Paths are repo-relative
+  POSIX strings — the stable coordinate findings and baselines key on.
+- `Finding.fingerprint()` deliberately EXCLUDES the line number: a
+  baseline must survive unrelated edits above the finding, so identity is
+  (check, file, enclosing symbol, detail key), not a line.
+- Baselines are JSONL (one object per line) because JSON has no comments
+  and every baselined finding must carry a one-line `why` justification —
+  the file is the reviewed list of intentional exemptions, not a dumping
+  ground (`python -m dcgan_tpu.analysis --write-baseline` drafts entries
+  with `why` left as TODO).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_SUPPRESS_RE = re.compile(r"#\s*dcg:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One invariant violation at a concrete site."""
+
+    check: str      # "DCG001".."DCG006"
+    path: str       # repo-relative POSIX path
+    line: int       # 1-based line of the offending node
+    symbol: str     # enclosing function/class qualname, or "<module>"
+    key: str        # stable detail (sink name, key literal, call name...)
+    message: str
+
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        """Line-free identity — what suppression baselines match on."""
+        return (self.check, self.path, self.symbol, self.key)
+
+    def to_json(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+    def baseline_entry(self, why: str = "TODO: justify") -> Dict[str, str]:
+        return {"check": self.check, "path": self.path,
+                "symbol": self.symbol, "key": self.key, "why": why}
+
+
+class SourceFile:
+    """One parsed python file plus the lookup structure checkers need."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path.replace(os.sep, "/")
+        self.source = source
+        self.tree = ast.parse(source)
+        # module dotted name ("dcgan_tpu.train.services") — the call-graph
+        # checker resolves cross-module imports through it
+        self.module = self.path[:-3].replace("/", ".") \
+            if self.path.endswith(".py") else self.path.replace("/", ".")
+        self.suppressed: Dict[int, set] = {}
+        for i, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                ids = {t.strip().upper() for t in m.group(1).split(",")
+                       if t.strip()}
+                self.suppressed[i] = ids
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        # local alias -> (module, original name) for `from X import y` —
+        # checkers use it to see through un-qualified calls
+        # (`from time import time; time()` is still time.time)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = \
+                        (node.module, alias.name)
+
+    @classmethod
+    def from_source(cls, source: str, path: str) -> "SourceFile":
+        return cls(path, source)
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        return finding.check in self.suppressed.get(finding.line, ())
+
+    def enclosing_symbol(self, node: ast.AST) -> str:
+        """Dotted qualname of the innermost enclosing def/class chain."""
+        parts: List[str] = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+
+@dataclasses.dataclass
+class Config:
+    """Checker knobs. The defaults describe THIS repo; fixture suites pass
+    synthetic paths that land inside (or outside) the scopes below."""
+
+    # DCG004: modules whose metric-key literals must appear in the
+    # inventory (dcgan_tpu/train/event_keys.py unless overridden here)
+    inventory: Optional[Dict[str, str]] = None
+    parity_modules: Tuple[str, ...] = (
+        "dcgan_tpu/train/trainer.py",
+        "dcgan_tpu/train/coordination.py",
+    )
+    # DCG006: modules whose mutating filesystem calls must be retried
+    # (utils/retry.retry_io) or explicitly fenced with try/except OSError
+    io_modules: Tuple[str, ...] = (
+        "dcgan_tpu/train/services.py",
+        "dcgan_tpu/utils/checkpoint.py",
+        "dcgan_tpu/utils/metrics.py",
+    )
+    # DCG003: the one file allowed to name jax's shard_map directly
+    shard_map_exempt: Tuple[str, ...] = ("dcgan_tpu/utils/backend.py",)
+
+    def load_inventory(self) -> Dict[str, str]:
+        if self.inventory is not None:
+            return self.inventory
+        from dcgan_tpu.train.event_keys import EVENT_KEYS
+
+        return EVENT_KEYS
+
+
+def collect_sources(paths: Sequence[str], root: str) -> List[SourceFile]:
+    """Every .py file under `paths`, parsed, with repo-relative names."""
+    out: List[SourceFile] = []
+    seen = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        files: List[str] = []
+        if os.path.isdir(p):
+            for dirpath, dirnames, names in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                files.extend(os.path.join(dirpath, n)
+                             for n in sorted(names) if n.endswith(".py"))
+        elif p.endswith(".py") and os.path.isfile(p):
+            files.append(p)
+        else:
+            raise ValueError(
+                f"path {p!r} is not a directory or an existing .py file")
+        for f in files:
+            rel = os.path.relpath(f, root).replace(os.sep, "/")
+            if rel in seen:
+                continue
+            seen.add(rel)
+            with open(f, encoding="utf-8") as fh:
+                out.append(SourceFile(rel, fh.read()))
+    return out
+
+
+# -- AST helpers shared by the checkers --------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for a pure Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Tuple[Optional[str], str]:
+    """(terminal callee name, dotted receiver or '') for a Call node."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return func.id, ""
+    if isinstance(func, ast.Attribute):
+        return func.attr, dotted(func.value) or ""
+    return None, ""
+
+
+def iter_calls(node: ast.AST):
+    """Every Call in `node`'s subtree (nested defs and lambdas included —
+    the conservative read: code textually inside a function is attributed
+    to it, which is exactly right for worker closures and retry thunks)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            yield n
+
+
+def lexical_def(sf: SourceFile, site: ast.AST,
+                name: str) -> Optional[ast.AST]:
+    """The def named `name` visible from `site`: innermost enclosing
+    function scopes first, then module level — how thread-target
+    closures, retry thunks, and jitted local bodies are resolved. Shared
+    by the thread and hygiene checkers so their resolution semantics
+    cannot drift."""
+    cur: Optional[ast.AST] = site
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Module)):
+            for child in ast.walk(cur):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) \
+                        and child.name == name:
+                    return child
+        cur = sf.parents.get(cur)
+    return None
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: str) -> List[Dict[str, str]]:
+    entries: List[Dict[str, str]] = []
+    if not path or not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, start=1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError as e:
+                raise ValueError(
+                    f"{path}:{i}: unparseable baseline line: {e}") from e
+            missing = [k for k in ("check", "path", "symbol", "key", "why")
+                       if k not in obj]
+            if missing:
+                raise ValueError(
+                    f"{path}:{i}: baseline entry missing {missing} "
+                    f"(every exemption needs a 'why' justification)")
+            if str(obj["why"]).strip().upper().startswith("TODO"):
+                # reject the --write-baseline draft placeholder: an entry
+                # is an exemption only once a human wrote its reason
+                raise ValueError(
+                    f"{path}:{i}: baseline entry for {obj['key']!r} still "
+                    "carries the draft 'TODO' justification — replace it "
+                    "with the real reason before committing")
+            entries.append(obj)
+    return entries
+
+
+def split_baselined(findings: Sequence[Finding],
+                    baseline: Sequence[Dict[str, str]]
+                    ) -> Tuple[List[Finding], List[Finding]]:
+    """(new findings, baselined findings). Matching is MULTISET-wise:
+    each baseline entry absorbs at most one finding, so a second
+    violation landing on an already-exempted fingerprint (another bare
+    write in the same function, say) still fails the run instead of
+    hiding behind the reviewed entry."""
+    import collections
+
+    budget = collections.Counter(
+        (e["check"], e["path"], e["symbol"], e["key"]) for e in baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if budget[fp] > 0:
+            budget[fp] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# -- driver ------------------------------------------------------------------
+
+def run_checks(sources: Sequence[SourceFile], config: Optional[Config] = None,
+               checks: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the requested checkers (default: all) over the parsed sources;
+    per-line `# dcg: disable=` suppressions are already applied."""
+    from dcgan_tpu.analysis import donation, hygiene, parity, threads
+
+    registry = {
+        "DCG001": threads.check_collectives_off_dispatch,
+        "DCG002": donation.check_donation_hazard,
+        "DCG003": hygiene.check_raw_shard_map,
+        "DCG004": parity.check_key_inventory,
+        "DCG005": hygiene.check_traced_body_hygiene,
+        "DCG006": hygiene.check_bare_io,
+    }
+    config = config or Config()
+    if checks:
+        checks = [c.upper() for c in checks]
+        unknown = sorted(set(checks) - set(registry))
+        if unknown:
+            raise ValueError(
+                f"unknown check ID(s) {unknown}; valid: {sorted(registry)}")
+    by_path = {sf.path: sf for sf in sources}
+    findings: List[Finding] = []
+    for check_id in checks or sorted(registry):
+        for f in registry[check_id](list(sources), config):
+            sf = by_path.get(f.path)
+            if sf is not None and sf.is_suppressed(f):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return findings
+
+
+def default_root() -> str:
+    """The repo root (parent of the dcgan_tpu package directory)."""
+    import dcgan_tpu
+
+    return os.path.dirname(os.path.dirname(
+        os.path.abspath(dcgan_tpu.__file__)))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(default_root(), "dcgan_tpu", "analysis",
+                        "baseline.jsonl")

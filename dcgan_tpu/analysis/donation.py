@@ -1,0 +1,220 @@
+"""DCG002: donated buffers must be XLA-owned when the compile cache is on.
+
+The jaxlib 0.4.37 hazard PR 5 shipped guards for (utils/checkpoint.py):
+executables DESERIALIZED from the persistent compilation cache donate
+buffers in place with none of the safety fresh-compiled ones have —
+donating a tensorstore-restored or `device_put` buffer corrupts the heap,
+and `device_get`'s zero-copy views silently mutate under a later donated
+dispatch. Every value that flows from `device_get` / `device_put` /
+an Orbax `_mgr.restore(...)` into a donating jit argument must first pass
+through `owned_host_copy` / `_rebase_onto_xla_buffers` / `device_copy`.
+
+Scope: function-local taint tracking, statements in textual order.
+
+- sources: any expression whose subtree calls `device_get`/`device_put`
+  (any receiver) or `restore` on a `*_mgr` receiver;
+- sanitizers: `owned_host_copy`, `_rebase_onto_xla_buffers`,
+  `device_copy` — an expression containing a sanitizer call is clean
+  (the sanitizer's output is what flows onward);
+- propagation: direct aliasing only (`x = tainted_name`, conditional
+  expressions, tuples) — routing taint through arbitrary calls would flag
+  every `int(device_get(step))` derived scalar;
+- sinks: calls to names bound from `jax.jit(..., donate_argnums=...)`
+  anywhere in the module, and `pt.step/multi_step/d_update/g_update`
+  style dispatches (attr gated on a `pt` receiver).
+
+Cross-function flows are out of static reach; the committed guards at the
+module boundaries (restore/rollback/snapshot paths) plus the parity and
+chaos suites own those.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set
+
+from dcgan_tpu.analysis.core import (
+    Config,
+    Finding,
+    SourceFile,
+    call_name,
+    iter_calls,
+)
+
+CHECK_ID = "DCG002"
+
+SANITIZERS = frozenset({
+    "owned_host_copy", "_rebase_onto_xla_buffers", "device_copy",
+})
+_DONATING_ATTRS = frozenset({"step", "multi_step", "d_update", "g_update"})
+
+
+def _is_source_call(call: ast.Call) -> bool:
+    name, receiver = call_name(call)
+    if name in ("device_get", "device_put"):
+        return True
+    return name == "restore" and receiver.split(".")[-1].endswith("_mgr")
+
+
+def _expr_state(expr: ast.AST, tainted: Set[str]) -> Optional[bool]:
+    """True = tainted, False = clean, None = neither (untracked)."""
+    for call in iter_calls(expr):
+        name, _ = call_name(call)
+        if name in SANITIZERS:
+            return False
+    for call in iter_calls(expr):
+        if _is_source_call(call):
+            return True
+    # direct aliasing only
+    if isinstance(expr, ast.Name):
+        return True if expr.id in tainted else None
+    if isinstance(expr, ast.IfExp):
+        a = _expr_state(expr.body, tainted)
+        b = _expr_state(expr.orelse, tainted)
+        if a or b:
+            return True
+        return None
+    if isinstance(expr, ast.Tuple):
+        states = [_expr_state(e, tainted) for e in expr.elts]
+        if any(s is True for s in states):
+            return True
+        return None
+    return None
+
+
+def _donating_names(tree: ast.AST) -> Set[str]:
+    """Names assigned from jax.jit(..., donate_argnums=...) calls."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        name, receiver = call_name(value)
+        if name != "jit":
+            continue
+        if not any(kw.arg == "donate_argnums" for kw in value.keywords):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                out.add(target.id)
+    return out
+
+
+def _is_donating_call(call: ast.Call, donating: Set[str]) -> Optional[str]:
+    name, receiver = call_name(call)
+    if name is None:
+        return None
+    if receiver == "" and name in donating:
+        return name
+    # whole-segment receiver match: `pt.step` donates, `opt.step` is an
+    # optimizer and must never trip the heuristic
+    if name in _DONATING_ATTRS and any(
+            seg in ("pt", "pt_backoff") for seg in receiver.split(".")):
+        return f"{receiver}.{name}"
+    return None
+
+
+def _statements(body: List[ast.stmt]):
+    """Statements in textual order, descending into compound blocks but
+    NOT into nested function/class scopes (each def gets its own taint
+    pass — mixing scopes would smear taint across unrelated functions)."""
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield stmt
+        for attr in ("body", "orelse", "finalbody", "handlers"):
+            sub = getattr(stmt, attr, None)
+            if not sub:
+                continue
+            for item in sub:
+                if isinstance(item, ast.ExceptHandler):
+                    yield from _statements(item.body)
+                elif isinstance(item, ast.stmt):
+                    yield from _statements([item])
+
+
+def _stmt_exprs(stmt: ast.stmt) -> List[ast.AST]:
+    """The expressions evaluated by the statement ITSELF (not by the
+    sub-statements of its blocks, which get their own turn)."""
+    if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.Expr, ast.Return)):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.AnnAssign):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, (ast.If, ast.While, ast.Assert)):
+        return [stmt.test]
+    if isinstance(stmt, ast.For):
+        return [stmt.iter]
+    if isinstance(stmt, ast.With):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Raise) and stmt.exc is not None:
+        return [stmt.exc]
+    return []
+
+
+def check_donation_hazard(sources: Sequence[SourceFile],
+                          config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in sources:
+        donating = _donating_names(sf.tree)
+        funcs = [n for n in ast.walk(sf.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs + [sf.tree]:
+            body = fn.body if hasattr(fn, "body") else []
+            tainted: Set[str] = set()
+            for stmt in _statements(body):
+                # a donating call in this statement fed a tainted value?
+                stmt_calls = [c for expr in _stmt_exprs(stmt)
+                              for c in iter_calls(expr)]
+                for call in stmt_calls:
+                    sink = _is_donating_call(call, donating)
+                    if sink is None:
+                        continue
+                    for arg in list(call.args) + [kw.value
+                                                  for kw in call.keywords]:
+                        state = _expr_state(arg, tainted)
+                        if state is True:
+                            key = arg.id if isinstance(arg, ast.Name) \
+                                else "<expr>"
+                            findings.append(Finding(
+                                check=CHECK_ID, path=sf.path,
+                                line=call.lineno,
+                                symbol=sf.enclosing_symbol(call),
+                                key=f"{sink}({key})",
+                                message=(
+                                    f"value {key!r} flows from device_get/"
+                                    f"device_put/Orbax restore into "
+                                    f"donating call {sink!r} without "
+                                    "passing through owned_host_copy/"
+                                    "_rebase_onto_xla_buffers — under the "
+                                    "persistent compile cache a "
+                                    "deserialized executable donates this "
+                                    "buffer in place and corrupts the "
+                                    "heap (utils/checkpoint.py)")))
+                # then update taint from assignments in this statement
+                if isinstance(stmt, ast.Assign):
+                    state = _expr_state(stmt.value, tainted)
+                    for target in stmt.targets:
+                        if isinstance(target, ast.Name):
+                            if state is True:
+                                tainted.add(target.id)
+                            elif state is False:
+                                tainted.discard(target.id)
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                    if isinstance(stmt.target, ast.Name):
+                        state = _expr_state(stmt.value, tainted)
+                        if state is True:
+                            tainted.add(stmt.target.id)
+                        elif state is False:
+                            tainted.discard(stmt.target.id)
+    # module-level pass double-counts function statements; dedupe
+    seen = set()
+    out = []
+    for f in findings:
+        k = (f.path, f.line, f.key)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

@@ -1,0 +1,112 @@
+"""DCG004: every trainer metric/JSONL key must be in the gated inventory.
+
+The parity contract (DESIGN.md §6b, tests/test_services.py,
+tests/test_chaos.py): with every new flag at its default, the trainer's
+JSONL event stream must be byte-identical to the previous build — new
+keys may appear only when their feature activates. The contract used to
+be enforced after the fact, by the parity A/B noticing a diff; this
+checker moves the failure to lint time. Every namespaced key literal the
+trainer (and the fleet-metrics builder) emits must appear in the declared
+inventory `dcgan_tpu/train/event_keys.py`, annotated with the knob that
+gates it (or "always") — so an ungated new key fails `python -m
+dcgan_tpu.analysis` before it fails the parity A/B.
+
+Extraction is syntactic: any string constant in the scanned modules that
+looks like a metric key (`<namespace>/...` with a known namespace), plus
+f-strings whose leading constant is a namespaced prefix (recorded as
+`prefix*` and matched against wildcard inventory entries). Keys built
+through a prefix parameter in another module (StepTimer's `perf/`,
+StartupProfile's `perf/startup/`) are declared in the inventory and
+pinned by the runtime completeness tests in tests/test_analysis.py — the
+static pass and the runtime test together close the loop.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Sequence, Tuple
+
+from dcgan_tpu.analysis.core import Config, Finding, SourceFile
+
+CHECK_ID = "DCG004"
+
+#: namespaces that mark a string literal as a metric/JSONL event key
+KEY_NAMESPACES = ("perf", "fleet", "eval", "anomaly", "data", "sample")
+
+_KEY_RE = re.compile(
+    r"^(?:%s)/[A-Za-z0-9_./]+$" % "|".join(KEY_NAMESPACES))
+_PREFIX_RE = re.compile(
+    r"^(?:%s)/[A-Za-z0-9_./]*$" % "|".join(KEY_NAMESPACES))
+
+
+def key_in_inventory(key: str, inventory: Dict[str, str]) -> bool:
+    """Exact entry, or a wildcard entry ('perf/compile_ms/*') whose prefix
+    matches. A literal extracted as a prefix wildcard ('sample/*') needs a
+    wildcard entry covering it."""
+    if key in inventory:
+        return True
+    for entry in inventory:
+        if entry.endswith("*") and key[:-1 if key.endswith("*") else None] \
+                .startswith(entry[:-1]):
+            if key.endswith("*"):
+                # wildcard literal: the inventory wildcard must be at
+                # least as general
+                if entry[:-1] and key[:-1].startswith(entry[:-1]):
+                    return True
+            else:
+                return True
+    return False
+
+
+def _extract_keys(sf: SourceFile) -> List[Tuple[str, int]]:
+    out: List[Tuple[str, int]] = []
+    # constants living inside f-strings are reported once, as the
+    # f-string's prefix wildcard — not again as bare literals
+    fstring_parts = {id(v) for node in ast.walk(sf.tree)
+                     if isinstance(node, ast.JoinedStr)
+                     for v in node.values}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if id(node) not in fstring_parts and _KEY_RE.match(node.value):
+                out.append((node.value, node.lineno))
+        elif isinstance(node, ast.JoinedStr) and node.values:
+            first = node.values[0]
+            if isinstance(first, ast.Constant) \
+                    and isinstance(first.value, str) \
+                    and "/" in first.value \
+                    and _PREFIX_RE.match(first.value):
+                out.append((first.value + "*", node.lineno))
+    return out
+
+
+def check_key_inventory(sources: Sequence[SourceFile],
+                        config: Config) -> List[Finding]:
+    inventory = config.load_inventory()
+    findings: List[Finding] = []
+    for sf in sources:
+        if sf.path not in config.parity_modules:
+            continue
+        for key, line in _extract_keys(sf):
+            if key_in_inventory(key, inventory):
+                continue
+            findings.append(Finding(
+                check=CHECK_ID, path=sf.path, line=line,
+                symbol="<key>", key=key,
+                message=(
+                    f"metric key {key!r} is not in the declared event-key "
+                    "inventory (dcgan_tpu/train/event_keys.py) — add it "
+                    "with the knob that gates it (or 'always' if it may "
+                    "appear in default-flag runs), so the parity contract "
+                    "is checked at lint time instead of failing the "
+                    "JSONL A/B")))
+    # one finding per key per file (the same literal often appears at a
+    # read site and a write site)
+    seen = set()
+    out = []
+    for f in findings:
+        k = (f.path, f.key)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

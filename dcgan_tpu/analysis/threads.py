@@ -1,0 +1,284 @@
+"""DCG001: no mesh-wide collective reachable from a non-dispatch thread.
+
+The collective-thread rule (DESIGN.md §6b): collectives issued from a
+per-process background thread have no cross-process ordering against the
+dispatch thread's collectives — two processes interleaving them
+differently deadlock the mesh. The rule lives in docstrings and review
+discipline; this checker makes it mechanical.
+
+Roots — code that runs OFF the dispatch thread:
+- every `threading.Thread(target=X)` target (positional or keyword),
+- the callable handed to any `.submit(X, ...)` call (the services-worker
+  task submissions in the trainer; ThreadPoolExecutor.submit matches the
+  same shape, which is correct — pool tasks are off-thread too).
+
+From each root the checker walks a best-effort call graph (bounded BFS):
+bare-name calls resolve within the defining module, through `from X
+import f` / `import X as alias` edges into other scanned package modules,
+and `self.method` resolves within the enclosing class. Dynamic calls
+(`task.fn()`, `self._hook(...)`) simply terminate the walk — the runtime
+tripwire (analysis/tripwire.py, `DCGAN_THREAD_CHECKS=1`) is the dynamic
+complement that catches paths the AST cannot resolve.
+
+Sinks — the known collective entry points:
+- the collective primitives and multihost transports by terminal name
+  (`psum`, `all_gather`, `process_allgather`, `sync_global_devices`, ...),
+- this package's collective helpers (`_allgather_*`,
+  `fleet_health_gather`, `anomaly_consensus`, `warmup_barrier`),
+- Checkpointer's collective methods (`restore_latest`, `maybe_save`,
+  `delete_steps_after` by name; the generic `save`/`wait` only when the
+  receiver names a checkpointer — `ckpt.save` yes, `img.save` no),
+- compiled ParallelTrain dispatches (`pt.step`, `pt.sample`, ... — attr
+  names gated on a `pt`/`pt_backoff` receiver).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from dcgan_tpu.analysis.core import (
+    Config,
+    Finding,
+    SourceFile,
+    call_name,
+    dotted,
+    iter_calls,
+)
+
+CHECK_ID = "DCG001"
+
+# collective callees flagged by terminal name alone (distinctive enough
+# that a bare-name match is evidence, whatever the receiver)
+TERMINAL_COLLECTIVES = frozenset({
+    "process_allgather", "sync_global_devices", "broadcast_one_to_all",
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all", "ppermute",
+    "_allgather_i32", "_allgather_f32", "fleet_health_gather",
+    "anomaly_consensus", "warmup_barrier",
+    "restore_latest", "maybe_save", "delete_steps_after",
+})
+
+# generic attr names that are collective only on specific receivers:
+# attr -> (match mode, hints). "segment" matches a whole receiver segment
+# exactly (so `pt.step` trips but `opt.step`/`script.step` never do);
+# "substr" matches inside any segment (checkpointer handles are named
+# ckpt/best_ckpt/checkpointer — all carry the token).
+_PT = ("segment", ("pt", "pt_backoff"))
+_CKPT = ("substr", ("ckpt",))
+RECEIVER_GATED = {
+    "save": _CKPT, "wait": _CKPT,
+    "step": _PT, "multi_step": _PT, "gen_fakes": _PT,
+    "d_update": _PT, "g_update": _PT, "sample": _PT,
+    "summarize": _PT, "eval_losses": _PT, "init": _PT,
+}
+
+_MAX_DEPTH = 10
+
+
+def _receiver_gate(attr: str, receiver: str) -> bool:
+    gate = RECEIVER_GATED.get(attr)
+    if not gate:
+        return False
+    mode, hints = gate
+    segments = receiver.split(".") if receiver else []
+    if mode == "segment":
+        return any(seg in hints for seg in segments)
+    return any(any(h in seg for h in hints) for seg in segments)
+
+
+def _is_sink(name: Optional[str], receiver: str) -> Optional[str]:
+    if name is None:
+        return None
+    if name in TERMINAL_COLLECTIVES:
+        return name
+    if _receiver_gate(name, receiver):
+        return f"{receiver}.{name}" if receiver else name
+    return None
+
+
+class _Module:
+    """Per-file function/import index for call resolution."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        # module-level functions by name
+        self.functions: Dict[str, ast.AST] = {}
+        # class name -> {method name -> node}
+        self.methods: Dict[str, Dict[str, ast.AST]] = {}
+        # local alias -> imported module dotted name
+        self.mod_imports: Dict[str, str] = {}
+        # local name -> (module dotted name, function name)
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        for node in sf.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.methods[node.name] = {
+                    n.name: n for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.mod_imports[alias.asname
+                                     or alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    # could be a module OR a function; record both readings
+                    self.mod_imports.setdefault(
+                        local, f"{node.module}.{alias.name}")
+                    self.from_imports[local] = (node.module, alias.name)
+
+
+class _Graph:
+    def __init__(self, sources: Sequence[SourceFile]):
+        self.modules: Dict[str, _Module] = {}
+        for sf in sources:
+            self.modules[sf.module] = _Module(sf)
+
+    def resolve(self, mod: _Module, cls: Optional[ast.ClassDef],
+                name: str, receiver: str
+                ) -> Optional[Tuple[_Module, Optional[ast.ClassDef],
+                                    ast.AST]]:
+        """Best-effort: the (module, class-context, node) a call lands in."""
+        if receiver == "self" and cls is not None:
+            target = mod.methods.get(cls.name, {}).get(name)
+            if target is not None:
+                return mod, cls, target
+            return None
+        if receiver == "":
+            if name in mod.functions:
+                return mod, None, mod.functions[name]
+            imp = mod.from_imports.get(name)
+            if imp is not None:
+                other = self.modules.get(imp[0])
+                if other is not None and imp[1] in other.functions:
+                    return other, None, other.functions[imp[1]]
+            return None
+        # one-level module attribute: alias.func(...)
+        head = receiver.split(".")[0]
+        if "." not in receiver and head in mod.mod_imports:
+            other = self.modules.get(mod.mod_imports[head])
+            if other is not None and name in other.functions:
+                return other, None, other.functions[name]
+        return None
+
+
+def _roots(sf: SourceFile) -> List[Tuple[ast.AST, str, ast.AST]]:
+    """(root callable expr, description, call site) for every Thread target
+    and .submit() payload in the file."""
+    out = []
+    for call in iter_calls(sf.tree):
+        name, receiver = call_name(call)
+        if name == "Thread" and (receiver in ("", "threading")
+                                 or receiver.endswith("threading")):
+            target = next((kw.value for kw in call.keywords
+                           if kw.arg == "target"), None)
+            if target is None and len(call.args) >= 2:
+                # Thread(group, target, ...): the positional target is the
+                # SECOND slot — args[0] is `group`
+                target = call.args[1]
+            if target is not None:
+                out.append((target, "threading.Thread target", call))
+        elif name == "submit" and call.args:
+            out.append((call.args[0], f"{receiver or 'executor'}.submit "
+                        "task", call))
+    return out
+
+
+def _resolve_local(sf: SourceFile, mod: _Module, site: ast.AST,
+                   name: str) -> Optional[ast.AST]:
+    """A def named `name` in the lexical scope chain of `site`, falling
+    back to module level — how nested worker closures are found."""
+    from dcgan_tpu.analysis.core import lexical_def
+
+    return lexical_def(sf, site, name) or mod.functions.get(name)
+
+
+def check_collectives_off_dispatch(sources: Sequence[SourceFile],
+                                   config: Config) -> List[Finding]:
+    graph = _Graph(sources)
+    findings: List[Finding] = []
+    for sf in sources:
+        mod = graph.modules[sf.module]
+        for target, kind, site in _roots(sf):
+            cls = sf.enclosing_class(site)
+            start: Optional[Tuple[_Module, Optional[ast.ClassDef],
+                                  ast.AST]] = None
+            root_name = "<lambda>"
+            if isinstance(target, ast.Lambda):
+                start = (mod, cls, target)
+            elif isinstance(target, ast.Name):
+                root_name = target.id
+                node = _resolve_local(sf, mod, site, target.id)
+                if node is not None:
+                    start = (mod, cls, node)
+                else:
+                    # direct sink handed as the callable itself
+                    sink = _is_sink(target.id, "")
+                    if sink:
+                        findings.append(_finding(sf, site, kind, target.id,
+                                                 sink, [target.id]))
+                    continue
+            elif isinstance(target, ast.Attribute):
+                attr = target.attr
+                receiver = dotted(target.value) or ""
+                root_name = f"{receiver}.{attr}" if receiver else attr
+                sink = _is_sink(attr, receiver)
+                if sink:
+                    findings.append(_finding(sf, site, kind, root_name,
+                                             sink, [root_name]))
+                    continue
+                start = None
+                resolved = graph.resolve(mod, cls, attr, receiver)
+                if resolved is not None:
+                    start = resolved
+            if start is None:
+                continue
+            hit = _walk(graph, start, root_name)
+            if hit is not None:
+                sink, chain = hit
+                findings.append(_finding(sf, site, kind, root_name, sink,
+                                         chain))
+    return findings
+
+
+def _walk(graph: _Graph,
+          start: Tuple[_Module, Optional[ast.ClassDef], ast.AST],
+          root_name: str) -> Optional[Tuple[str, List[str]]]:
+    """BFS from the root callable; (sink, call chain) on the first hit."""
+    queue: List[Tuple[_Module, Optional[ast.ClassDef], ast.AST,
+                      List[str]]] = [(*start, [root_name])]
+    seen = {id(start[2])}
+    depth = 0
+    while queue and depth < _MAX_DEPTH:
+        depth += 1
+        next_queue = []
+        for mod, cls, node, chain in queue:
+            for call in iter_calls(node):
+                name, receiver = call_name(call)
+                sink = _is_sink(name, receiver)
+                if sink is not None:
+                    return sink, chain + [sink]
+                if name is None:
+                    continue
+                resolved = graph.resolve(mod, cls, name, receiver)
+                if resolved is None or id(resolved[2]) in seen:
+                    continue
+                seen.add(id(resolved[2]))
+                next_queue.append((*resolved, chain + [name]))
+        queue = next_queue
+    return None
+
+
+def _finding(sf: SourceFile, site: ast.AST, kind: str, root: str,
+             sink: str, chain: List[str]) -> Finding:
+    return Finding(
+        check=CHECK_ID, path=sf.path, line=site.lineno,
+        symbol=sf.enclosing_symbol(site),
+        key=f"{root}->{sink}",
+        message=(f"{kind} {root!r} reaches collective entry point "
+                 f"{sink!r} (call chain: {' -> '.join(chain)}); mesh-wide "
+                 "collectives must stay on the dispatch thread "
+                 "(DESIGN.md §6b) — move the collective to the dispatch "
+                 "thread and queue only the host-local tail"))

@@ -76,6 +76,14 @@ class ParallelTrain:
                            # drift apart
 
     def __post_init__(self):
+        # thread-discipline tripwire (ISSUE 8): under DCGAN_THREAD_CHECKS=1
+        # every program dispatch asserts it runs on the dispatch thread —
+        # wrapped BEFORE the programs dict is derived so both surfaces
+        # agree; a no-op (nothing wrapped) when the tripwire is off. Both
+        # backends construct ParallelTrain, so this one hook covers them.
+        from dcgan_tpu.analysis import tripwire
+
+        tripwire.wrap_parallel_train(self)
         if not self.programs:
             object.__setattr__(self, "programs", {
                 "init": self.init, "train_step": self.step,

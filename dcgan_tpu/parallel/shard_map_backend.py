@@ -1,8 +1,10 @@
 """Explicit-collective training backend: shard_map + psum/pmean by hand.
 
 The default backend (parallel/api.py) states shardings and lets GSPMD insert
-the collectives. This one is the other idiom: `jax.shard_map` gives each
-device its per-shard program and the cross-replica communication is written
+the collectives. This one is the other idiom: shard_map — via the
+`utils/backend.shard_map` shim over `jax.experimental.shard_map`, the only
+form this container's jax 0.4.37 ships (DCG003) — gives each device its
+per-shard program and the cross-replica communication is written
 out explicitly — `lax.pmean` over the "data" axis for gradients, losses, and
 BatchNorm moments (train/steps.py and ops/norm.py take `axis_name` for exactly
 this path). Same synchronous-SPMD semantics, same ICI collectives on TPU; what

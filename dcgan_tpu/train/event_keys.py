@@ -1,0 +1,81 @@
+"""The trainer's declared JSONL event-key inventory (ISSUE 8, DCG004).
+
+One entry per metric key (or wildcard prefix) the trainer can emit,
+mapped to the knob that gates it — "always" means the key may appear in a
+default-flags run and is therefore covered by the byte-parity contract
+(tests/test_services.py async-vs-inline, tests/test_chaos.py
+rollback-armed-vs-default). Everything else must be invisible until its
+knob activates, which is exactly what the gating annotation documents.
+
+The static half of the enforcement is analysis/parity.py (DCG004): every
+namespaced key literal in trainer.py/coordination.py must appear here, so
+a new ungated key fails the lint before it fails the parity A/B. The
+runtime half is tests/test_analysis.py's completeness tests: the keys
+StepTimer / StartupProfile / fleet_metrics actually produce are checked
+against this inventory, closing the loop for keys built from prefix
+parameters the static pass cannot see.
+
+Un-namespaced scalar keys (d_loss, g_loss, r1, gp, ...) are the device
+metric dict from train/steps.py — replicated program outputs, identical
+in every mode by the step-equivalence tests — and are deliberately
+outside this inventory.
+
+This module must stay import-light (no jax): the analyzer loads it on
+every lint pass.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+EVENT_KEYS: Dict[str, str] = {
+    # -- StepTimer window stats (utils/profiling.py, prefix "perf/") -----
+    "perf/step_ms_mean": "always",
+    "perf/step_ms_p50": "always",
+    "perf/step_ms_p90": "always",
+    "perf/step_ms_max": "always",
+    "perf/steps_per_sec": "always",
+    "perf/images_per_sec": "always",
+    "perf/host_ms_mean": "always",
+    "perf/dispatch_occupancy": "always",
+
+    # -- startup report (written only when a warm-start knob is active;
+    #    always printed to stdout) ---------------------------------------
+    "perf/startup/*": "compile_cache_dir|aot_warmup",
+    "perf/compile_cache_requests": "compile_cache_dir",
+    "perf/compile_cache_hits": "compile_cache_dir",
+    "perf/compile_cache_misses": "compile_cache_dir",
+    "perf/compile_ms/*": "aot_warmup",
+    "perf/restore/verify_files": "compile_cache_dir|aot_warmup",
+    "perf/restore/verify_bytes": "compile_cache_dir|aot_warmup",
+    "perf/restore/verify_cached_bytes": "compile_cache_dir|aot_warmup",
+    "perf/restore/verify_ms": "compile_cache_dir|aot_warmup",
+
+    # -- on-demand device-trace digest (ISSUE 6) -------------------------
+    "perf/device/compute_ms": "profile_dir|profile_trigger",
+    "perf/device/collective_ms": "profile_dir|profile_trigger",
+    "perf/device/idle_gap_ms": "profile_dir|profile_trigger",
+    "perf/device/span_ms": "profile_dir|profile_trigger",
+    "perf/device/step_ms": "profile_dir|profile_trigger",
+
+    # -- recovery counters (absent until nonzero — the parity contract's
+    #    "new keys only when the feature activates" clause) --------------
+    "anomaly/rollbacks": "nan_policy=rollback",
+    "data/corrupt_records": "nonzero quarantine count",
+
+    # -- fleet health plane (ISSUE 6, coordination.fleet_metrics) --------
+    "fleet/step_ms_max": "fleet_health_steps",
+    "fleet/step_ms_min": "fleet_health_steps",
+    "fleet/step_ms_skew": "fleet_health_steps",
+    "fleet/slowest_host": "fleet_health_steps",
+    "fleet/host_ms_max": "fleet_health_steps",
+    "fleet/queue_depth_max": "fleet_health_steps",
+    "fleet/dropped_total": "fleet_health_steps",
+    "fleet/rollbacks_total": "fleet_health_steps",
+    "fleet/corrupt_total": "fleet_health_steps",
+
+    # -- probes ----------------------------------------------------------
+    "sample/*": "sample_every_steps",
+    "eval/fid": "fid_every_steps",
+    "eval/kid": "fid_every_steps",
+}

@@ -56,6 +56,7 @@ from typing import Any, Iterator, Optional
 import jax
 import numpy as np
 
+from dcgan_tpu.analysis import tripwire
 from dcgan_tpu.config import TrainConfig, load_config, save_config
 from dcgan_tpu.data import (
     DataConfig,
@@ -284,10 +285,17 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
     # without anyone ever polling the flag (idempotent — _train's own call
     # is then a no-op)
     initialize_multihost()
+    # thread-discipline tripwire (ISSUE 8, DCGAN_THREAD_CHECKS=1): wrap
+    # the collective entry points and mark THIS thread as the dispatch
+    # thread for the run — any collective issued from a background thread
+    # raises instead of deadlocking the mesh minutes later. Free when the
+    # env knob is off (nothing wrapped, the scope is a bare yield).
+    tripwire.maybe_install()
     stop = _install_stop_handlers(cfg)
     try:
-        return _train(cfg, synthetic_data=synthetic_data,
-                      max_steps=max_steps, stop=stop)
+        with tripwire.dispatch_scope():
+            return _train(cfg, synthetic_data=synthetic_data,
+                          max_steps=max_steps, stop=stop)
     finally:
         stop.restore()
 

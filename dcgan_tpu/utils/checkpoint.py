@@ -443,13 +443,17 @@ class Checkpointer:
         """Rename a failing step dir to `<step>.corrupt` (chief-only): the
         step scanner and Orbax both ignore non-integer names, the bytes stay
         on disk for forensics, and the manifest stays beside it."""
+        from dcgan_tpu.utils.retry import retry_io
+
         src = os.path.join(self.directory, str(step))
         dst = f"{src}.corrupt"
         print(f"[dcgan_tpu] checkpoint step {step} failed integrity check "
               f"({why}) — marking {dst} and falling back to the newest "
               f"intact checkpoint", flush=True)
         if jax.process_index() == 0 and os.path.isdir(src):
-            os.replace(src, dst)
+            # retried (DCG006): a transient rename failure here would
+            # abort the very fallback that exists to survive bad bytes
+            retry_io(lambda: os.replace(src, dst), tag="ckpt-corrupt-mark")
         try:
             self._mgr.reload()  # drop the manager's cached step metadata
         except Exception:  # older orbax without reload(): rebuild instead

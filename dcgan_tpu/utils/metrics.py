@@ -209,7 +209,12 @@ class MetricWriter:
         self._path = os.path.join(logdir, filename)
         self._tb = None
         if enabled:
-            os.makedirs(logdir, exist_ok=True)
+            from dcgan_tpu.utils.retry import retry_io
+
+            # retried (DCG006): one-shot at construction, and a transient
+            # mkdir failure would kill the run before its first step
+            retry_io(lambda: os.makedirs(logdir, exist_ok=True),
+                     tag="metrics-mkdir")
             if tensorboard:
                 from dcgan_tpu.utils.tb_events import TBEventWriter
 

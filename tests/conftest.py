@@ -21,6 +21,18 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
+# Thread-discipline tripwire (ISSUE 8): the whole tier runs with the
+# runtime collective-thread checks armed — every trainer/coordination/
+# pipeline test doubles as a zero-trips proof at its knobs, and trainer
+# SUBPROCESSES (chaos drill, bench pins) inherit the env var and arm
+# themselves in train(). setdefault so DCGAN_THREAD_CHECKS=0 can switch
+# it off for a bisection run.
+os.environ.setdefault("DCGAN_THREAD_CHECKS", "1")
+
+from dcgan_tpu.analysis import tripwire  # noqa: E402
+
+tripwire.maybe_install()
+
 
 def pytest_collection_modifyitems(config, items):
     """Two-tier suite (markers registered in pytest.ini): anything not

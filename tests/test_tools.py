@@ -309,6 +309,35 @@ class TestTrainerLoopParsing:
         assert pts == [(500, 30.0), (1000, 33.2), (5000, 46.0)]
 
 
+class TestAnalysisSmoke:
+    """ISSUE 8's tier-1 pin (the chaos-marker pattern's tool-subprocess
+    shape): `python -m dcgan_tpu.analysis` over the whole package must
+    stay CLEAN — zero non-baselined findings — inside a short budget, so
+    any new collective-thread / donation / shard_map / parity-key /
+    traced-hygiene / bare-IO violation fails the tier before it fails a
+    mesh. Suppressions and the committed baseline are the escape hatches
+    (each baseline entry carries its justification)."""
+
+    def test_analyzer_clean_over_package_within_budget(self):
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "-m", "dcgan_tpu.analysis", "--json"],
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=120)
+        elapsed = time.monotonic() - t0
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
+        rows = [json.loads(l) for l in res.stdout.splitlines() if l.strip()]
+        summary = rows[-1]
+        assert summary["label"] == "dcgan-analysis"
+        assert summary["new_findings"] == 0
+        assert summary["files"] > 50  # the walk really covered the package
+        # a plain AST pass: seconds, not minutes — the budget keeps the
+        # tier-1 pin from quietly eating the tier
+        assert elapsed < 60, f"analyzer took {elapsed:.0f}s"
+
+
 @pytest.mark.chaos
 class TestChaosDrillSmoke:
     """tools/chaos_drill.py --smoke pinned into tier-1 (not slow, per the

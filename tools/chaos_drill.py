@@ -42,6 +42,11 @@ never silently trains garbage, never hangs.
                                                          restored state, run
                                                          completes; replay is
                                                          bit-exact
+    thread-checks         (no fault) DCGAN_THREAD_       tripwire arms, wraps
+                          CHECKS=1 runtime tripwire      every collective
+                                                         entry point, run
+                                                         completes with zero
+                                                         trips (ISSUE 8)
 
 Multi-host matrix (ISSUE 4, `--multihost`): the same contract under a REAL
 2-process jax.distributed job over localhost gRPC (tests/multihost_worker.py
@@ -125,12 +130,15 @@ def _state_sum(out: str) -> str:
 
 
 def _run_train(extra: dict, *, max_steps: int, synthetic: bool = True,
-               chaos: dict = None, timeout: int = 600):
+               chaos: dict = None, timeout: int = 600,
+               env_extra: dict = None):
     """One trainer subprocess; returns (rc, combined output)."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     env.pop("DCGAN_CHAOS", None)
     if chaos:
         env["DCGAN_CHAOS"] = json.dumps(chaos)
+    if env_extra:
+        env.update(env_extra)
     code = _DRIVER.format(extra=extra, synthetic=synthetic,
                           max_steps=max_steps)
     res = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
@@ -422,8 +430,32 @@ def scenario_pipeline_rollback(root: str) -> dict:
             "replay_bit_exact": True}
 
 
+def scenario_thread_checks(root: str) -> dict:
+    """(no fault) a short train under DCGAN_THREAD_CHECKS=1 (ISSUE 8): the
+    runtime thread-discipline tripwire wraps every collective entry point
+    (coordination transports, Checkpointer save/restore, the pt.* program
+    dispatches) and the DEFAULT dispatch path must complete with zero
+    trips — the end-to-end proof that the collective-thread rule
+    (DESIGN.md §6b) holds on the paths the AST walk cannot resolve. The
+    per-step save cadence exercises the wrapped Checkpointer.save on
+    every boundary."""
+    ck = os.path.join(root, "ck")
+    rc, out = _run_train(
+        dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+             save_model_secs=0.0),
+        max_steps=6, env_extra={"DCGAN_THREAD_CHECKS": "1"})
+    _check(rc == 0, f"trainer failed (rc={rc}): {out[-800:]}")
+    _check("thread-discipline tripwire armed" in out,
+           f"tripwire never armed: {out[-800:]}")
+    _check("ThreadDisciplineError" not in out,
+           f"tripwire tripped on the default dispatch path: {out[-800:]}")
+    _check("TRAIN_DONE step=6" in out, f"run did not complete: {out[-400:]}")
+    return {"tripwire_armed": True, "trips": 0, "final_step": 6}
+
+
 SCENARIOS = {
     "nan-rollback": scenario_nan_rollback,
+    "thread-checks": scenario_thread_checks,
     "pipeline-rollback": scenario_pipeline_rollback,
     "corrupt-record": scenario_corrupt_record,
     "corrupt-budget": scenario_corrupt_budget,
