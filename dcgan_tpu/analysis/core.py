@@ -125,6 +125,17 @@ class Config:
     parity_modules: Tuple[str, ...] = (
         "dcgan_tpu/train/trainer.py",
         "dcgan_tpu/train/coordination.py",
+        "dcgan_tpu/serve/server.py",
+        "dcgan_tpu/serve/__main__.py",
+    )
+    # DCG001: thread targets that ARE a dispatch thread by design — a
+    # subsystem whose single worker owns every collective/program dispatch
+    # (the serving plane's ServeWorker). Collectives reachable from these
+    # roots are on the right thread by definition; the runtime tripwire
+    # still polices them (the worker enters dispatch_scope), so the
+    # exemption is declared, not assumed. Format: "path::QualName".
+    dispatch_thread_targets: Tuple[str, ...] = (
+        "dcgan_tpu/serve/worker.py::ServeWorker._run",
     )
     # DCG006: modules whose mutating filesystem calls must be retried
     # (utils/retry.retry_io) or explicitly fenced with try/except OSError

@@ -32,7 +32,8 @@ from dcgan_tpu.analysis.core import Config, Finding, SourceFile
 CHECK_ID = "DCG004"
 
 #: namespaces that mark a string literal as a metric/JSONL event key
-KEY_NAMESPACES = ("perf", "fleet", "eval", "anomaly", "data", "sample")
+KEY_NAMESPACES = ("perf", "fleet", "eval", "anomaly", "data", "sample",
+                  "serve")
 
 _KEY_RE = re.compile(
     r"^(?:%s)/[A-Za-z0-9_./]+$" % "|".join(KEY_NAMESPACES))

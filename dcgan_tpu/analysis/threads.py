@@ -235,12 +235,34 @@ def check_collectives_off_dispatch(sources: Sequence[SourceFile],
                     start = resolved
             if start is None:
                 continue
+            if _declared_dispatch_owner(start, config):
+                # the target IS a dispatch thread by design (the serving
+                # plane's single worker, Config.dispatch_thread_targets):
+                # collectives reached from it are exactly where the rule
+                # wants them — the runtime tripwire still polices it
+                continue
             hit = _walk(graph, start, root_name)
             if hit is not None:
                 sink, chain = hit
                 findings.append(_finding(sf, site, kind, root_name, sink,
                                          chain))
     return findings
+
+
+def _declared_dispatch_owner(start: Tuple[_Module, Optional[ast.ClassDef],
+                                          ast.AST],
+                             config: Config) -> bool:
+    """Whether the resolved thread target is a declared dispatch-thread
+    owner ("path::QualName" in Config.dispatch_thread_targets)."""
+    targets = getattr(config, "dispatch_thread_targets", ())
+    if not targets:
+        return False
+    mod, cls, node = start
+    name = getattr(node, "name", None)
+    if name is None:
+        return False
+    qual = f"{cls.name}.{name}" if cls is not None else name
+    return f"{mod.sf.path}::{qual}" in targets
 
 
 def _walk(graph: _Graph,

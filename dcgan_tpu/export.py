@@ -23,7 +23,11 @@ Usage:
     # conditional checkpoints:    ...call(z, labels)       # labels int32
 
 A JSON sidecar (`<out>.json`) records the calling convention: z_dim,
-num_classes, image shape, checkpoint step, weight source (live vs EMA).
+num_classes, image shape, checkpoint step, weight source (live vs EMA),
+plus a `serving` block (ISSUE 9) — weight source and bucket-ladder hint —
+so `python -m dcgan_tpu.serve --artifact <out>` can cold-start the
+continuous-batching sampler server from the artifact alone, no
+checkpoint directory required.
 """
 
 from __future__ import annotations
@@ -42,11 +46,15 @@ def export_sampler(checkpoint_dir: str, out_path: str, *,
                    overrides: Optional[dict] = None,
                    use_ema: bool = False,
                    platforms: Sequence[str] = ("cpu", "tpu"),
-                   batch_size: int = 0) -> dict:
+                   batch_size: int = 0,
+                   max_serve_batch: int = 64) -> dict:
     """Bake the checkpoint's generator into a serialized artifact.
 
     batch_size=0 exports a symbolic batch dimension (serve any batch size);
     a positive value pins it (some embedders prefer static shapes).
+    `max_serve_batch` sizes the sidecar's serving bucket-ladder hint (the
+    default ladder `dcgan_tpu.serve` AOT-compiles when cold-starting from
+    this artifact; a pinned batch_size makes the ladder that one rung).
     Returns the sidecar metadata dict.
     """
     import jax
@@ -56,6 +64,7 @@ def export_sampler(checkpoint_dir: str, out_path: str, *,
     from dcgan_tpu.config import TrainConfig, resolve_model_config
     from dcgan_tpu.models.dcgan import sampler_apply
     from dcgan_tpu.parallel import make_mesh, make_parallel_train
+    from dcgan_tpu.serve.buckets import build_ladder
     from dcgan_tpu.utils.checkpoint import Checkpointer
 
     mcfg = resolve_model_config(checkpoint_dir, preset=preset,
@@ -110,6 +119,19 @@ def export_sampler(checkpoint_dir: str, out_path: str, *,
         "weights": "ema" if use_ema else "live",
         "platforms": list(platforms),
         "bytes": len(blob),
+        # serving calling convention (ISSUE 9): everything the sampler
+        # server needs to cold-start from this artifact WITHOUT the full
+        # checkpoint — which weights the bytes carry, and the bucket
+        # ladder its AOT warmup should compile (`python -m
+        # dcgan_tpu.serve --artifact <out>` reads this block; explicit
+        # --buckets overrides the hint)
+        "serving": {
+            "source": "ema" if use_ema else "live",
+            "bucket_ladder": (
+                [batch_size] if batch_size > 0
+                else list(build_ladder(max_serve_batch).buckets)),
+            "z_dist": "uniform(-1,1)",
+        },
     }
     with open(out_path + ".json", "w") as f:
         json.dump(meta, f, indent=2)
@@ -145,6 +167,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch_size", type=int, default=0,
                    help="pin the batch dimension (default 0 = symbolic: any "
                         "batch size at call time)")
+    p.add_argument("--max_serve_batch", type=int, default=64,
+                   help="top rung of the sidecar's serving bucket-ladder "
+                        "hint (symbolic-batch artifacts only)")
     p.add_argument("--preset", default=None,
                    help="named config supplying the architecture instead of "
                         "the checkpoint's config.json")
@@ -166,7 +191,7 @@ def main(argv: Optional[List[str]] = None) -> None:
         args.checkpoint_dir, args.out, preset=args.preset,
         overrides={n: getattr(args, n) for n in MODEL_OVERRIDE_FLAGS},
         use_ema=args.use_ema, platforms=args.platforms,
-        batch_size=args.batch_size)
+        batch_size=args.batch_size, max_serve_batch=args.max_serve_batch)
     print(f"[dcgan_tpu.export] step-{meta['step']} {meta['weights']} "
           f"sampler ({meta['arch']}, {meta['bytes']} bytes, "
           f"platforms {','.join(meta['platforms'])}) -> {args.out}")

@@ -130,9 +130,17 @@ def generate(args: argparse.Namespace) -> dict:
     state = restored
     step = int(jax.device_get(state["step"]))
 
-    # batch must tile the data axis for the sharded sample fn
+    # batch must tile the data axis for the sharded sample fn; the tail
+    # (num_images not divisible by batch_size) routes through the serving
+    # plane's bucket ladder (ISSUE 9): it snaps to the smallest ladder
+    # bucket covering the remainder — a small reused set of compiled
+    # shapes — instead of either re-running the full batch for a handful
+    # of images or tracing a one-off tail shape
+    from dcgan_tpu.serve.buckets import build_ladder
+
     data_axis = mesh.shape["data"]
     batch = -(-args.batch_size // data_axis) * data_axis
+    ladder = build_ladder(batch, data_axis)
 
     os.makedirs(args.out_dir, exist_ok=True)
     key = jax.random.key(args.seed)
@@ -146,21 +154,24 @@ def generate(args: argparse.Namespace) -> dict:
     made = 0
     batch_idx = 0
     while made < args.num_images:
+        remaining = args.num_images - made
+        n = batch if remaining >= batch else ladder.snap(remaining)
         z = args.truncation * jax.random.uniform(
             jax.random.fold_in(key, batch_idx),
-            (batch, mcfg.z_dim), minval=-1.0, maxval=1.0)
+            (n, mcfg.z_dim), minval=-1.0, maxval=1.0)
         if mcfg.num_classes:
             if args.class_id is not None:
-                labels = np.full((batch,), args.class_id, dtype=np.int32)
+                labels = np.full((n,), args.class_id, dtype=np.int32)
             else:
-                labels = np.arange(batch_idx * batch,
-                                   batch_idx * batch + batch,
+                # continue the class cycle across batches regardless of
+                # each batch's bucket size
+                labels = np.arange(made, made + n,
                                    dtype=np.int32) % mcfg.num_classes
             imgs = jax.device_get(pt.sample(state, z, jax.numpy.asarray(labels)))
         else:
             labels = None
             imgs = jax.device_get(pt.sample(state, z))
-        take = min(batch, args.num_images - made)
+        take = min(n, remaining)
         all_imgs.append(np.asarray(imgs[:take], dtype=np.float32))
         if labels is not None:
             all_labels.append(labels[:take])

@@ -1,0 +1,37 @@
+"""Serving plane (ISSUE 9): generation as a service.
+
+The reference's only generation surface is a sampler node inside the train
+graph (SURVEY.md §3.4); `generate.py` made it a CLI, `export.py` made it a
+portable artifact — this package makes it a *service*: a thread-safe
+request queue, a continuous batcher that snaps dynamic batches onto a
+small ladder of AOT-precompiled batch buckets (the PR 5 warmup discipline
+pointed at the sampler), a single dispatch thread owning every device
+program (the collective-thread rule, DESIGN.md §6b), and a lifecycle of
+cold start -> warm serving -> graceful drain on SIGTERM.
+
+Layers:
+- buckets.py  — the bucket ladder and the AOT sampler compile plan
+- sources.py  — where weights come from: a checkpoint (single-pass
+                verified restore) or a `.jaxexport` artifact + sidecar
+- server.py   — queue, batcher, backpressure, latency accounting
+- worker.py   — the dispatch thread (cold start + batch loop + drain)
+- __main__.py — `python -m dcgan_tpu.serve` entry point
+"""
+
+from dcgan_tpu.serve.buckets import (  # noqa: F401
+    BucketLadder,
+    build_ladder,
+    compile_buckets,
+    parse_buckets,
+    sampler_plan,
+)
+from dcgan_tpu.serve.server import (  # noqa: F401
+    Response,
+    SamplerServer,
+    ServeError,
+    ServeOverloadError,
+)
+from dcgan_tpu.serve.sources import (  # noqa: F401
+    ArtifactSource,
+    CheckpointSource,
+)

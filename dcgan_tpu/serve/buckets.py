@@ -1,0 +1,145 @@
+"""Bucket ladder: the only batch shapes the serving plane may dispatch.
+
+Continuous batching over XLA has one constraint the GPU-serving literature
+can gloss over: every distinct batch shape is its own compiled executable.
+A server that dispatches whatever batch the queue happens to hold retraces
+on nearly every flush — seconds of compile on the latency path of
+millisecond requests. The Gemma-on-TPU serving comparison (PAPERS.md,
+arXiv 2605.25645) makes the same move made here: pick a small ladder of
+batch buckets, AOT-compile the sampler at every rung up front (the PR 5
+`train/warmup.py` discipline pointed at the sampler instead of the train
+programs), and snap every dynamic batch UP to the nearest rung, padding
+with throwaway latent rows. The zero-recompile guarantee follows by
+construction: the worker only ever calls the per-bucket compiled
+executables built during warmup, so no live dispatch can trigger a trace
+(`tests/test_serve.py` pins this through `CompileCacheMonitor` — zero
+compile requests after warmup under a live persistent cache).
+
+`sampler_plan` emits the same `(name, fn, example_args)` rows
+`train/warmup.py::aot_compile` consumes; `compile_buckets` is the
+serve-side variant that KEEPS the compiled executables (warmup can throw
+its copies away because the trainer's live dispatch goes through the jit
+wrappers; the server dispatches the AOT executables directly — no
+first-call deserialize, no jit-cache lookup on the latency path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLadder:
+    """Ascending, granule-aligned batch sizes the server may dispatch.
+
+    `granule` is the device-tiling unit (the mesh's data-axis size for a
+    sharded sampler, 1 for an exported artifact): every bucket must divide
+    over it or the sharded sample program cannot accept the batch.
+    """
+
+    buckets: Tuple[int, ...]
+    granule: int = 1
+
+    def __post_init__(self):
+        if not self.buckets:
+            raise ValueError("bucket ladder must not be empty")
+        if self.granule < 1:
+            raise ValueError(f"granule must be >= 1, got {self.granule}")
+        if list(self.buckets) != sorted(set(self.buckets)):
+            raise ValueError(
+                f"buckets must be strictly ascending, got {self.buckets}")
+        bad = [b for b in self.buckets if b < 1 or b % self.granule]
+        if bad:
+            raise ValueError(
+                f"buckets {bad} are not positive multiples of the device "
+                f"granule {self.granule}")
+
+    @property
+    def max_bucket(self) -> int:
+        return self.buckets[-1]
+
+    def snap(self, n: int) -> int:
+        """The smallest bucket >= n — the shape a batch of n requests is
+        padded to. n past the top rung returns max_bucket (the caller
+        chunks oversized work across dispatches)."""
+        if n < 1:
+            raise ValueError(f"batch size must be >= 1, got {n}")
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.max_bucket
+
+
+def build_ladder(max_batch: int, granule: int = 1) -> BucketLadder:
+    """The default doubling ladder: granule, 2*granule, 4*granule, ...
+    capped by (and always including) `max_batch` rounded up to the
+    granule. Doubling keeps the rung count logarithmic — the AOT warmup
+    compiles one sampler per rung — while bounding padding waste at <2x
+    on any fill level."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if granule < 1:
+        raise ValueError(f"granule must be >= 1, got {granule}")
+    top = -(-max_batch // granule) * granule
+    rungs = []
+    b = granule
+    while b < top:
+        rungs.append(b)
+        b *= 2
+    rungs.append(top)
+    return BucketLadder(buckets=tuple(rungs), granule=granule)
+
+
+def parse_buckets(spec: str, granule: int = 1) -> BucketLadder:
+    """'8,16,32' -> BucketLadder — the CLI's explicit-ladder form."""
+    try:
+        rungs = tuple(sorted({int(tok) for tok in spec.split(",") if tok}))
+    except ValueError:
+        raise ValueError(
+            f"--buckets must be comma-separated ints, got {spec!r}"
+        ) from None
+    return BucketLadder(buckets=rungs, granule=granule)
+
+
+def sampler_plan(sample_fn: Callable, ladder: BucketLadder, z_dim: int, *,
+                 state: Any = None, num_classes: int = 0
+                 ) -> List[Tuple[str, Callable, tuple]]:
+    """(name, jitted fn, example args) for the sampler at every ladder
+    rung — the same row shape `train/warmup.py::build_warmup_plan`
+    produces and `aot_compile` consumes. `state` is the live train-state
+    pytree for a framework sampler (pt.sample(state, z[, labels])); None
+    for an artifact sampler whose weights are baked in (fn(z[, labels]))."""
+    import jax
+    import jax.numpy as jnp
+
+    plan: List[Tuple[str, Callable, tuple]] = []
+    for b in ladder.buckets:
+        z = jax.ShapeDtypeStruct((b, z_dim), jnp.float32)
+        args: tuple = (z,) if state is None else (state, z)
+        if num_classes:
+            args = args + (jax.ShapeDtypeStruct((b,), jnp.int32),)
+        plan.append((f"sampler@b{b}", sample_fn, args))
+    return plan
+
+
+def compile_buckets(plan: Sequence[Tuple[str, Callable, tuple]]
+                    ) -> Tuple[Dict[int, Callable], Dict[str, float]]:
+    """AOT-compile every planned rung; ({bucket: compiled executable},
+    {name: compile_ms}). With a persistent compile cache configured each
+    rung's compile primes (or deserializes from) the cache exactly like
+    the trainer's warmup — a warm serve restart pays bounded IO, not
+    compile — and the returned executables are what the dispatch thread
+    calls, so post-warmup serving can never trace."""
+    compiled: Dict[int, Callable] = {}
+    timings: Dict[str, float] = {}
+    for name, fn, args in plan:
+        t0 = time.perf_counter()
+        compiled[_bucket_of(name)] = fn.lower(*args).compile()
+        timings[name] = (time.perf_counter() - t0) * 1e3
+    return compiled, timings
+
+
+def _bucket_of(name: str) -> int:
+    return int(name.rsplit("@b", 1)[1])

@@ -1,0 +1,459 @@
+"""The sampler server: queue -> continuous batches -> bucketed dispatch.
+
+Request flow (ISSUE 9 tentpole): callers `submit()` generation requests
+from any thread; a single dispatch thread (worker.py) assembles them into
+dynamic batches, snaps each batch UP to the nearest AOT-precompiled
+bucket (buckets.py), dispatches the per-bucket compiled executable, and
+resolves each request's `Response` with its images and per-request
+latency accounting (queue wait, device time, end-to-end).
+
+Batching policy — the continuous-batching core:
+- a flush happens when the pending work fills the LARGEST bucket (no
+  reason to wait: the batch cannot grow) or when the OLDEST pending
+  request has waited `max_wait_ms` (the deadline flush: latency is
+  bounded by the knob even at trickle load);
+- requests coalesce in FIFO order; a request larger than the top bucket
+  is chunked across consecutive dispatches, its chunks never reordered
+  against later arrivals (drain-on-stop preserves the same ordering);
+- when the queue is full the OLDEST pending request is shed and its
+  Response fails with `ServeOverloadError` — the drop-oldest
+  backpressure of `train/services.py`, same rationale: under overload
+  the newest work is the most likely to still matter to its caller, and
+  a degraded server sheds load instead of growing an unbounded queue.
+
+Counters flow through `utils/metrics.py::CounterRegistry` (the serve_*
+CounterSnapshot fields) and `report()` emits the `serve/*` metric keys
+declared in `train/event_keys.py` — the same inventory discipline the
+trainer's keys live under (DCG004 lints this module against it).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from dcgan_tpu.serve.buckets import BucketLadder, build_ladder
+
+#: default request-queue bound: deep enough to absorb a burst several
+#: buckets long, shallow enough that a wedged device sheds load within
+#: seconds instead of hoarding latent arrays
+DEFAULT_MAX_QUEUE = 256
+
+
+class ServeError(RuntimeError):
+    """The serving plane failed (startup, dispatch, or shutdown)."""
+
+
+class ServeOverloadError(ServeError):
+    """This request was shed by drop-oldest backpressure."""
+
+
+class Response:
+    """Future-like handle for one request; resolved by the dispatch
+    thread. `meta` carries the latency accounting: queue_ms (submit ->
+    first dispatch), infer_ms (device dispatch + host materialize,
+    summed over chunks), total_ms (submit -> resolve), and the bucket
+    size(s) the request rode in."""
+
+    def __init__(self) -> None:
+        self._ev = threading.Event()
+        self.images: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+        self.meta: Dict[str, Any] = {}
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("request not resolved within timeout")
+        if self.error is not None:
+            raise self.error
+        return self.images
+
+    # -- dispatch-thread side ---------------------------------------------
+
+    def _resolve(self, images: np.ndarray, meta: Dict[str, Any]) -> None:
+        self.images = images
+        self.meta.update(meta)
+        self._ev.set()
+
+    def _fail(self, err: BaseException) -> None:
+        self.error = err
+        self._ev.set()
+
+
+class _Pending:
+    """One queued request, tracked by the batcher."""
+
+    __slots__ = ("num_images", "z", "labels", "seed", "serial", "resp",
+                 "t_submit", "t_first_dispatch", "remaining", "delivered",
+                 "parts", "buckets", "infer_ms", "cursor")
+
+    def __init__(self, num_images: int, z: Optional[np.ndarray],
+                 labels: Optional[np.ndarray], seed: Optional[int],
+                 serial: int):
+        self.num_images = num_images
+        self.z = z
+        self.labels = labels
+        self.seed = seed
+        self.serial = serial
+        self.resp = Response()
+        self.t_submit = time.monotonic()
+        self.t_first_dispatch: Optional[float] = None
+        self.remaining = num_images   # rows not yet taken into a batch
+        self.delivered = 0            # rows already returned by dispatches
+        self.parts: List[np.ndarray] = []
+        self.buckets: List[int] = []
+        self.infer_ms = 0.0
+        self.cursor = 0               # next z row to hand to a batch
+
+    def take_z(self, take: int, z_dim: int, base_seed: int) -> np.ndarray:
+        """The next `take` latent rows — the caller-provided z, or rows
+        drawn once per request from a deterministic per-request stream
+        (host RNG on the dispatch thread: nothing here is traced)."""
+        if self.z is None:
+            seed = self.seed if self.seed is not None \
+                else (base_seed, self.serial)
+            rng = np.random.default_rng(seed)
+            self.z = rng.uniform(-1.0, 1.0, (self.num_images, z_dim)) \
+                .astype(np.float32)
+        rows = self.z[self.cursor:self.cursor + take]
+        self.cursor += take
+        return rows
+
+    def take_labels(self, take: int) -> np.ndarray:
+        if self.labels is None:
+            return np.zeros((take,), np.int32)
+        start = self.cursor - take  # cursor already advanced by take_z
+        return np.asarray(self.labels[start:start + take], np.int32)
+
+
+class SamplerServer:
+    """Continuous-batching generation server over one weight source.
+
+    Lifecycle: `start()` spawns the dispatch thread, which cold-starts
+    (restore/deserialize + AOT bucket warmup) and flips warm; `submit()`
+    enqueues from any thread (accepted during cold start — they serve as
+    soon as the plane is warm); `stop(drain=True)` stops intake, lets the
+    worker drain the queue in FIFO order, and joins it. A worker failure
+    fails the in-flight requests loudly and poisons the server (later
+    submits are rejected, `stop()` re-raises) — the services-executor
+    discipline, not silent half-service.
+    """
+
+    def __init__(self, source, *, ladder: Optional[BucketLadder] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_batch: int = 64,
+                 max_queue: int = DEFAULT_MAX_QUEUE,
+                 max_wait_ms: float = 10.0,
+                 cache_dir: str = "",
+                 seed: int = 0,
+                 registry=None):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if max_wait_ms < 0:
+            raise ValueError(
+                f"max_wait_ms must be >= 0, got {max_wait_ms}")
+        self.source = source
+        self.max_batch = max_batch
+        self.max_queue = max_queue
+        self.max_wait_ms = max_wait_ms
+        self.cache_dir = cache_dir
+        self.seed = seed
+        self._explicit_ladder = ladder
+        self._explicit_buckets = tuple(buckets) if buckets else None
+        self.ladder: Optional[BucketLadder] = None   # set at cold start
+
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._queue: "collections.deque[_Pending]" = collections.deque()
+        self._draining = False
+        self._started = False
+        self._ready = threading.Event()
+        self._error: Optional[BaseException] = None
+        self._worker = None
+
+        # counters (ints/floats mutated under _lock, read lock-free by
+        # the registry providers — single-word reads are atomic enough
+        # for telemetry)
+        self.submitted = 0
+        self.completed = 0
+        self.dropped = 0
+        self.batches = 0
+        self.images_out = 0
+        self.padded_rows = 0
+        self.dispatched_rows = 0
+        self.queue_depth_max = 0
+        self._serial = 0
+        self._latencies_ms: List[float] = []
+
+        # cold-start / warmup accounting, filled by the worker
+        self.meta: Dict[str, Any] = {}
+        self.cold_ms: Dict[str, float] = {}
+        self.compile_ms: Dict[str, float] = {}
+        self._monitor = None
+        self._cache_post_warmup: Optional[Dict[str, float]] = None
+        self._t_warm: Optional[float] = None
+        self._t_drained: Optional[float] = None
+
+        from dcgan_tpu.utils.metrics import CounterRegistry
+
+        self.registry = registry if registry is not None \
+            else CounterRegistry()
+        self.registry.provide("serve_requests", lambda: self.submitted)
+        self.registry.provide("serve_completed", lambda: self.completed)
+        self.registry.provide("serve_dropped", lambda: self.dropped)
+        self.registry.provide("serve_batches", lambda: self.batches)
+        self.registry.provide("serve_queue", lambda: len(self._queue))
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Spawn the dispatch thread and block until the plane is warm
+        (cold start done, every bucket compiled); returns the source
+        metadata. Raises the cold-start error if startup failed."""
+        from dcgan_tpu.serve.worker import ServeWorker
+
+        with self._lock:
+            if self._started:
+                raise ServeError("server already started")
+            self._started = True
+        self._worker = ServeWorker(self)
+        self._worker.start()
+        if not self._ready.wait(timeout):
+            raise TimeoutError("serve cold start did not finish in time")
+        self.raise_if_failed()
+        return dict(self.meta)
+
+    def submit(self, num_images: int = 1, *,
+               z: Optional[np.ndarray] = None,
+               labels: Optional[np.ndarray] = None,
+               seed: Optional[int] = None) -> Response:
+        """Enqueue one generation request; returns its Response. Never
+        blocks on a full queue: the oldest pending request is shed
+        instead (drop-oldest), and a stopped/poisoned server rejects
+        immediately via the Response's error."""
+        if z is not None:
+            z = np.asarray(z, np.float32)
+            if z.ndim != 2:
+                raise ValueError(f"z must be [n, z_dim], got {z.shape}")
+            # z_dim is 0 until the source's cold start resolves it; the
+            # worker re-checks at assembly so a cold-start-window submit
+            # with the wrong width fails ITS response, not the server
+            if self.source.z_dim and z.shape[1] != self.source.z_dim:
+                raise ValueError(
+                    f"z width {z.shape[1]} != source z_dim "
+                    f"{self.source.z_dim}")
+            num_images = z.shape[0]
+        if num_images < 1:
+            raise ValueError(f"num_images must be >= 1, got {num_images}")
+        if labels is not None and len(labels) != num_images:
+            raise ValueError(
+                f"labels length {len(labels)} != num_images {num_images}")
+        with self._lock:
+            if self._draining or self._error is not None:
+                p = _Pending(num_images, z, labels, seed, -1)
+                p.resp._fail(ServeError(
+                    "server is stopped" if self._error is None else
+                    f"server failed: {self._error!r}"))
+                return p.resp
+            p = _Pending(num_images, z, labels, seed, self._serial)
+            self._serial += 1
+            self.submitted += 1
+            overload = ServeOverloadError(
+                f"request shed by drop-oldest backpressure "
+                f"(queue full at {self.max_queue})")
+            while len(self._queue) >= self.max_queue:
+                # shed the oldest NEVER-DISPATCHED request: a partially
+                # dispatched head already has device work banked — failing
+                # it would throw those chunks away. With nothing
+                # undispatched to shed (max_queue=1 around a chunking
+                # head), the NEW request is the one rejected.
+                victim = next((q for q in self._queue if q.delivered == 0),
+                              None)
+                if victim is None:
+                    self.dropped += 1
+                    p.resp._fail(overload)
+                    return p.resp
+                self._queue.remove(victim)
+                self.dropped += 1
+                victim.resp._fail(overload)
+            self._queue.append(p)
+            self.queue_depth_max = max(self.queue_depth_max,
+                                       len(self._queue))
+            self._work.notify_all()
+        return p.resp
+
+    def stop(self, drain: bool = True, timeout: float = 120.0) -> None:
+        """Stop intake; with drain=True (the graceful path) the worker
+        finishes every queued request in FIFO order first. Joins the
+        worker and re-raises its failure, if any. Safe to call twice.
+        A drain that outlives `timeout` raises TimeoutError — never a
+        silent success banner over a still-running worker whose queued
+        responses would die with the process."""
+        with self._lock:
+            if not self._started:
+                return
+            self._draining = True
+            if not drain:
+                while self._queue:
+                    self._queue.popleft().resp._fail(
+                        ServeError("server stopped before dispatch"))
+            self._work.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout)
+            if self._worker.is_alive():
+                raise TimeoutError(
+                    f"serve drain did not finish within {timeout}s — the "
+                    "dispatch thread is still running; requests are NOT "
+                    "all resolved")
+        if self._monitor is not None:
+            self._monitor.close()
+        self.raise_if_failed()
+
+    def raise_if_failed(self) -> None:
+        err = self._error
+        if err is not None:
+            raise ServeError(f"serve dispatch thread failed: {err!r}") \
+                from err
+
+    # -- reporting ----------------------------------------------------------
+
+    def counters(self):
+        """One coherent CounterSnapshot (serve_* fields live)."""
+        return self.registry.snapshot()
+
+    def report(self) -> Dict[str, float]:
+        """The serve/* metric row (keys declared in train/event_keys.py):
+        request/latency/throughput accounting plus the cold-start
+        breakdown and the zero-recompile proof."""
+        with self._lock:
+            lat = sorted(self._latencies_ms)
+            out: Dict[str, float] = {
+                "serve/requests": float(self.submitted),
+                "serve/completed": float(self.completed),
+                "serve/dropped": float(self.dropped),
+                "serve/batches": float(self.batches),
+                "serve/images": float(self.images_out),
+                "serve/queue_depth_max": float(self.queue_depth_max),
+                "serve/pad_frac": (self.padded_rows
+                                   / max(1, self.dispatched_rows)),
+            }
+            end = self._t_drained if self._t_drained is not None \
+                else time.monotonic()
+            if self._t_warm is not None and end > self._t_warm:
+                out["serve/samples_per_sec"] = \
+                    self.images_out / (end - self._t_warm)
+        if lat:
+            out["serve/p50_ms"] = _percentile(lat, 50.0)
+            out["serve/p99_ms"] = _percentile(lat, 99.0)
+            out["serve/mean_ms"] = float(np.mean(lat))
+        # explicit literals (not a prefix f-string) so DCG004 lints each
+        # cold-start key against the inventory individually
+        for key, src in (("serve/restore_ms", "restore_ms"),
+                         ("serve/warmup_ms", "warmup_ms"),
+                         ("serve/cold_start_ms", "cold_start_ms")):
+            if src in self.cold_ms:
+                out[key] = self.cold_ms[src]
+        for name, ms in self.compile_ms.items():
+            out[f"serve/compile_ms/{name}"] = ms
+        if self._monitor is not None:
+            now = self._monitor.counters()
+            out["perf/compile_cache_requests"] = now["requests"]
+            out["perf/compile_cache_hits"] = now["hits"]
+            out["perf/compile_cache_misses"] = now["misses"]
+            if self._cache_post_warmup is not None:
+                # the zero-recompile guarantee, measured: compile requests
+                # issued AFTER the AOT bucket warmup (must stay 0 — every
+                # served batch hits a precompiled bucket executable)
+                out["serve/recompiles_after_warmup"] = (
+                    now["requests"]
+                    - self._cache_post_warmup["requests"])
+        return out
+
+    # -- worker side (dispatch thread only) ---------------------------------
+
+    def _resolve_ladder(self) -> BucketLadder:
+        """The bucket ladder for this run, aligned to the source's device
+        granule: explicit ladder/buckets > the artifact sidecar's hint >
+        the default doubling ladder under max_batch."""
+        granule = self.source.granule
+        if self._explicit_ladder is not None:
+            rungs: Tuple[int, ...] = self._explicit_ladder.buckets
+        elif self._explicit_buckets is not None:
+            rungs = self._explicit_buckets
+        else:
+            hint = getattr(self.source, "ladder_hint", lambda: None)()
+            if hint:
+                rungs = tuple(int(b) for b in hint)
+            else:
+                return build_ladder(self.max_batch, granule)
+        return BucketLadder(buckets=tuple(sorted(set(rungs))),
+                            granule=granule)
+
+    def _next_batch(self) -> Optional[Tuple[List[Tuple[_Pending, int]],
+                                            int]]:
+        """Block until a batch is due (full top bucket, deadline, or
+        drain), then pop it FIFO; None once draining and empty — the
+        worker's exit signal."""
+        with self._lock:
+            while True:
+                if not self._queue:
+                    if self._draining:
+                        self._t_drained = time.monotonic()
+                        return None
+                    self._work.wait(0.1)
+                    continue
+                total = sum(p.remaining for p in self._queue)
+                top = self.ladder.max_bucket
+                now = time.monotonic()
+                deadline = self._queue[0].t_submit + self.max_wait_ms / 1e3
+                if total >= top or now >= deadline or self._draining:
+                    return self._pop_spans(top)
+                self._work.wait(min(deadline - now, 0.1))
+
+    def _pop_spans(self, top: int) -> Tuple[List[Tuple[_Pending, int]],
+                                            int]:
+        spans: List[Tuple[_Pending, int]] = []
+        total = 0
+        while self._queue and total < top:
+            p = self._queue[0]
+            take = min(p.remaining, top - total)
+            p.remaining -= take
+            if p.remaining == 0:
+                self._queue.popleft()
+            spans.append((p, take))
+            total += take
+        return spans, total
+
+    def _record_batch(self, bucket: int, pad: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.padded_rows += pad
+            self.dispatched_rows += bucket
+
+    def _record_done(self, p: _Pending, total_ms: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self.images_out += p.num_images
+            self._latencies_ms.append(total_ms)
+
+    def _fail_all(self, err: BaseException) -> None:
+        """Worker death: fail everything still queued, poison intake."""
+        with self._lock:
+            self._error = err
+            while self._queue:
+                self._queue.popleft().resp._fail(err)
+            self._work.notify_all()
+
+
+def _percentile(sorted_ms: List[float], pct: float) -> float:
+    """Nearest-rank percentile over an ascending list."""
+    idx = min(len(sorted_ms) - 1,
+              max(0, int(round(pct / 100.0 * (len(sorted_ms) - 1)))))
+    return sorted_ms[idx]

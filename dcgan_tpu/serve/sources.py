@@ -1,0 +1,166 @@
+"""Weight sources for the sampler server: checkpoint or exported artifact.
+
+Both present the same surface to the worker thread:
+
+- `prepare()` — the cold-start heavy lifting (restore / deserialize +
+  model build), called ON the dispatch thread so every collective the
+  restore issues stays where the collective-thread rule wants it;
+  returns the source's metadata dict.
+- `bucket_plan(ladder)` — the `(name, fn, args)` AOT rows for
+  `buckets.compile_buckets`.
+- `bind(compiled)` — hand back the per-bucket executables.
+- `sample(bucket, z[, labels])` — one device dispatch through the bound
+  executable, materialized to a host array.
+
+CheckpointSource is the full-fidelity path: it builds the same
+ParallelTrain surface the trainer uses and restores device-resident
+weights ONCE through the single-pass verified restore
+(`utils/checkpoint.py` — stat screen, CRC fused with the payload read,
+quarantine + newest-intact fallback), then serves EMA or live weights per
+the flag. ArtifactSource is the light path: a `.jaxexport` StableHLO blob
+plus its JSON sidecar is enough to cold-start — no checkpoint directory,
+no framework state; the sidecar's serving block (ISSUE 9 satellite:
+z_dim, num_classes, weight source, bucket-ladder hint) supplies the
+calling convention.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from dcgan_tpu.serve.buckets import BucketLadder, sampler_plan
+
+
+class CheckpointSource:
+    """Serve from a trained checkpoint through the framework sampler."""
+
+    def __init__(self, checkpoint_dir: str, *, use_ema: bool = False,
+                 preset: Optional[str] = None,
+                 overrides: Optional[dict] = None,
+                 max_batch: int = 64):
+        self.checkpoint_dir = checkpoint_dir
+        self.use_ema = use_ema
+        self.preset = preset
+        self.overrides = overrides
+        self.max_batch = max_batch
+        self.z_dim = 0
+        self.num_classes = 0
+        self.granule = 1
+        self._state = None
+        self._pt = None
+        self._compiled: Dict[int, Callable] = {}
+
+    def prepare(self) -> dict:
+        import jax
+
+        from dcgan_tpu.config import TrainConfig, resolve_model_config
+        from dcgan_tpu.parallel import make_mesh, make_parallel_train
+        from dcgan_tpu.utils.checkpoint import Checkpointer
+
+        mcfg = resolve_model_config(self.checkpoint_dir, preset=self.preset,
+                                    overrides=self.overrides)
+        mesh = make_mesh(TrainConfig(model=mcfg).mesh)
+        self.granule = mesh.shape["data"]
+        batch = -(-self.max_batch // self.granule) * self.granule
+        cfg = TrainConfig(model=mcfg, batch_size=batch,
+                          checkpoint_dir=self.checkpoint_dir,
+                          # any value > 0 makes sample() read
+                          # state["ema_gen"] (the generate.py convention)
+                          g_ema_decay=0.999 if self.use_ema else 0.0)
+        self._pt = make_parallel_train(cfg, mesh)
+        state = self._pt.init(jax.random.key(0))
+        restored = Checkpointer(self.checkpoint_dir).restore_latest(state)
+        if restored is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.checkpoint_dir}")
+        self._state = restored
+        self.z_dim = mcfg.z_dim
+        self.num_classes = mcfg.num_classes
+        return {"source": "checkpoint",
+                "step": int(jax.device_get(restored["step"])),
+                "weights": "ema" if self.use_ema else "live"}
+
+    def bucket_plan(self, ladder: BucketLadder):
+        return sampler_plan(self._pt.sample, ladder, self.z_dim,
+                            state=self._state,
+                            num_classes=self.num_classes)
+
+    def bind(self, compiled: Dict[int, Callable]) -> None:
+        self._compiled = compiled
+
+    def sample(self, bucket: int, z: np.ndarray,
+               labels: Optional[np.ndarray] = None) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        args: tuple = (self._state, jnp.asarray(z, jnp.float32))
+        if self.num_classes:
+            lbl = labels if labels is not None \
+                else np.zeros((bucket,), np.int32)
+            args = args + (jnp.asarray(lbl, jnp.int32),)
+        return np.asarray(jax.device_get(self._compiled[bucket](*args)))
+
+
+class ArtifactSource:
+    """Serve from an `export.py` `.jaxexport` artifact + JSON sidecar —
+    no checkpoint, no framework state: the weights are baked into the
+    StableHLO bytes and the sidecar carries the calling convention."""
+
+    def __init__(self, path: str):
+        self.path = path
+        sidecar_path = path + ".json"
+        if not os.path.exists(sidecar_path):
+            raise FileNotFoundError(
+                f"artifact sidecar {sidecar_path} not found — export.py "
+                "writes it next to the artifact; the server needs its "
+                "calling convention (z_dim / num_classes / ladder hint)")
+        with open(sidecar_path) as f:
+            self.sidecar = json.load(f)
+        self.z_dim = int(self.sidecar["z_dim"])
+        self.num_classes = int(self.sidecar.get("num_classes", 0) or 0)
+        self.granule = 1  # replicated artifact: any batch size tiles
+        self._jit_call = None
+        self._compiled: Dict[int, Callable] = {}
+
+    def ladder_hint(self) -> Optional[list]:
+        """The exporter's suggested bucket ladder (sidecar serving block),
+        or None for artifacts written before ISSUE 9."""
+        return (self.sidecar.get("serving") or {}).get("bucket_ladder")
+
+    def prepare(self) -> dict:
+        import jax
+        from jax import export as jexport
+
+        with open(self.path, "rb") as f:
+            exported = jexport.deserialize(f.read())
+        # jit the artifact's call so each ladder rung AOT-lowers like any
+        # other program (an un-jitted Exported.call retraces per call)
+        self._jit_call = jax.jit(exported.call)
+        serving = self.sidecar.get("serving") or {}
+        return {"source": "artifact",
+                "step": self.sidecar.get("step"),
+                "weights": serving.get("source",
+                                       self.sidecar.get("weights", "live"))}
+
+    def bucket_plan(self, ladder: BucketLadder):
+        return sampler_plan(self._jit_call, ladder, self.z_dim,
+                            num_classes=self.num_classes)
+
+    def bind(self, compiled: Dict[int, Callable]) -> None:
+        self._compiled = compiled
+
+    def sample(self, bucket: int, z: np.ndarray,
+               labels: Optional[np.ndarray] = None) -> np.ndarray:
+        import jax
+        import jax.numpy as jnp
+
+        args: tuple = (jnp.asarray(z, jnp.float32),)
+        if self.num_classes:
+            lbl = labels if labels is not None \
+                else np.zeros((bucket,), np.int32)
+            args = args + (jnp.asarray(lbl, jnp.int32),)
+        return np.asarray(jax.device_get(self._compiled[bucket](*args)))

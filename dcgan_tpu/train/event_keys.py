@@ -78,4 +78,28 @@ EVENT_KEYS: Dict[str, str] = {
     "sample/*": "sample_every_steps",
     "eval/fid": "fid_every_steps",
     "eval/kid": "fid_every_steps",
+
+    # -- serving plane (ISSUE 9, dcgan_tpu/serve) ------------------------
+    # These keys appear only in the serve entry point's own event stream
+    # (`python -m dcgan_tpu.serve --events_dir`/`--report`), never in the
+    # trainer's JSONL — the trainer parity contract cannot see them by
+    # construction; the annotation names the subsystem that emits them.
+    # DCG004 lints serve/server.py and serve/__main__.py against this
+    # inventory the same way it lints the trainer.
+    "serve/requests": "serve entrypoint",
+    "serve/completed": "serve entrypoint",
+    "serve/dropped": "serve entrypoint",
+    "serve/batches": "serve entrypoint",
+    "serve/images": "serve entrypoint",
+    "serve/queue_depth_max": "serve entrypoint",
+    "serve/pad_frac": "serve entrypoint",
+    "serve/samples_per_sec": "serve entrypoint",
+    "serve/p50_ms": "serve entrypoint",
+    "serve/p99_ms": "serve entrypoint",
+    "serve/mean_ms": "serve entrypoint",
+    "serve/restore_ms": "serve entrypoint",
+    "serve/warmup_ms": "serve entrypoint",
+    "serve/cold_start_ms": "serve entrypoint",
+    "serve/compile_ms/*": "serve entrypoint",
+    "serve/recompiles_after_warmup": "serve entrypoint (compile cache on)",
 }

@@ -40,6 +40,13 @@ class CounterSnapshot:
     compile_cache_requests: int = 0
     compile_cache_hits: int = 0
     compile_cache_misses: int = 0
+    # serving plane (ISSUE 9, dcgan_tpu/serve): zero in training runs —
+    # the SamplerServer registers these on its own registry instance
+    serve_requests: int = 0        # generation requests accepted
+    serve_completed: int = 0       # requests fully resolved with images
+    serve_dropped: int = 0         # requests shed by drop-oldest
+    serve_batches: int = 0         # bucketed device dispatches
+    serve_queue: int = 0           # requests pending on the serve queue
 
     def as_dict(self) -> Dict[str, int]:
         # flat getattr walk, not dataclasses.asdict: asdict deep-copies

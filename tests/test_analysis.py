@@ -17,11 +17,12 @@ from dcgan_tpu.analysis import core, tripwire
 from dcgan_tpu.analysis.parity import key_in_inventory
 
 
-def run(snippets, checks=None, inventory=None):
+def run(snippets, checks=None, inventory=None, **cfg_kw):
     """snippets: {relpath: source} -> findings (suppressions applied)."""
     sources = [core.SourceFile.from_source(src, path)
                for path, src in snippets.items()]
-    cfg = core.Config(inventory=inventory if inventory is not None else {})
+    cfg = core.Config(inventory=inventory if inventory is not None else {},
+                      **cfg_kw)
     return core.run_checks(sources, cfg, checks=checks)
 
 
@@ -138,6 +139,43 @@ def start(rows, path):
     threading.Thread(target=worker, args=(rows, path)).start()
 '''
         assert run({"dcgan_tpu/x.py": src}, checks=["DCG001"]) == []
+
+    DISPATCH_OWNER = '''
+import threading
+
+class ServeWorker:
+    def _run(self):
+        self._ckpt.restore_latest(self._state)
+        self._pt.sample(self._state, None)
+
+    def start(self):
+        threading.Thread(target=self._run).start()
+'''
+
+    def test_declared_dispatch_thread_target_exempt(self):
+        """ISSUE 9: a thread target declared in
+        Config.dispatch_thread_targets IS a dispatch thread by design
+        (the serving plane's single worker owns every collective) — no
+        finding; the same code undeclared still trips on the
+        `restore_latest` terminal-name sink."""
+        path = "dcgan_tpu/serve/w.py"
+        flagged = run({path: self.DISPATCH_OWNER}, checks=["DCG001"])
+        assert [f.key for f in flagged] == [
+            "self._run->restore_latest"]
+        clean = run({path: self.DISPATCH_OWNER}, checks=["DCG001"],
+                    dispatch_thread_targets=(
+                        f"{path}::ServeWorker._run",))
+        assert clean == []
+
+    def test_dispatch_owner_declaration_is_exact(self):
+        """The allowlist matches path::QualName exactly — a different
+        class or file with the same method name keeps tripping."""
+        path = "dcgan_tpu/serve/w.py"
+        fs = run({path: self.DISPATCH_OWNER}, checks=["DCG001"],
+                 dispatch_thread_targets=(
+                     "dcgan_tpu/serve/other.py::ServeWorker._run",
+                     f"{path}::OtherWorker._run"))
+        assert [f.check for f in fs] == ["DCG001"]
 
     def test_real_services_and_coordination_are_clean(self):
         sources = core.collect_sources(
@@ -289,6 +327,22 @@ class TestKeyInventory:
         assert run({"dcgan_tpu/evals/x.py": src}, checks=["DCG004"],
                    inventory={}) == []
 
+    def test_serve_namespace_linted_in_serve_modules(self):
+        """ISSUE 9: the serving plane's server/__main__ modules are in the
+        parity scope and the `serve/` namespace marks key literals — an
+        undeclared serve key fails the lint like a trainer key would."""
+        src = 'row = {"serve/new_counter": 1.0}\n'
+        path = "dcgan_tpu/serve/server.py"
+        fs = run({path: src}, checks=["DCG004"], inventory={})
+        assert [f.key for f in fs] == ["serve/new_counter"]
+        assert run({path: src}, checks=["DCG004"],
+                   inventory={"serve/new_counter": "serve entrypoint"}) \
+            == []
+        # serve literals outside the declared parity modules stay out of
+        # scope, same as every other namespace
+        assert run({"dcgan_tpu/serve/buckets.py": src}, checks=["DCG004"],
+                   inventory={}) == []
+
     def test_runtime_steptimer_keys_covered(self):
         """The inventory-completeness half the static pass cannot see:
         the keys StepTimer actually produces are all declared."""
@@ -327,7 +381,9 @@ class TestKeyInventory:
 
         cfg = core.Config()
         sources = core.collect_sources(
-            [core.default_root() + "/dcgan_tpu/train"], core.default_root())
+            [core.default_root() + "/dcgan_tpu/train",
+             core.default_root() + "/dcgan_tpu/serve"],
+            core.default_root())
         found = set()
         for sf in sources:
             if sf.path in cfg.parity_modules:

@@ -493,6 +493,66 @@ class TestBenchStartupSmoke:
         assert elapsed < 240, f"bench_startup smoke took {elapsed:.0f}s"
 
 
+@pytest.mark.chaos
+class TestBenchServeSmoke:
+    """tools/bench_serve.py --smoke pinned into tier-1 (ISSUE 9, the
+    chaos-marker pattern): the cold-vs-warm serving A/B over a bursty
+    Poisson trace must keep proving the serving-plane invariants end to
+    end through real subprocesses — zero sampler recompiles after the
+    AOT bucket warmup on BOTH arms (every served batch hits a
+    precompiled bucket), warm cache hits with zero misses, and the
+    finite-trace drain losing nothing — inside an explicit runtime
+    budget so the pin can never quietly eat the tier. The full-size run
+    is standalone: `JAX_PLATFORMS=cpu python tools/bench_serve.py`."""
+
+    def test_cold_warm_serve_ab_passes_within_budget(self):
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "tools/bench_serve.py", "--smoke"], cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        elapsed = time.monotonic() - t0
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
+        row = json.loads(res.stdout.strip().splitlines()[-1])
+        assert row["label"] == "bench-serve" and row["ok"] is True
+        assert row["checks"]["cold_zero_recompiles_after_warmup"]
+        assert row["checks"]["warm_zero_recompiles_after_warmup"]
+        assert row["checks"]["warm_has_hits"]
+        assert row["checks"]["warm_zero_misses"]
+        assert row["cold"]["p99_ms"] >= row["cold"]["p50_ms"] > 0
+        assert row["warm"]["completed"] == row["trace"]["requests"]
+        # three tiny subprocesses (1 trainer + 2 serve arms, ~40 s on a
+        # quiet host, compile-dominated); ~4x headroom for CI contention
+        assert elapsed < 240, f"bench_serve smoke took {elapsed:.0f}s"
+
+    def test_serve_drain_scenario_within_budget(self):
+        """chaos_drill serve-drain pinned alongside: SIGTERM mid-load ->
+        in-flight requests complete, queue drains, clean exit (the
+        serving plane's first chaos consumer)."""
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "tools/chaos_drill.py", "--only",
+             "serve-drain"], cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        elapsed = time.monotonic() - t0
+        lines = [json.loads(l) for l in res.stdout.splitlines()
+                 if l.startswith("{")]
+        summary = lines[-1]
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
+        assert summary["scenarios"] == 1 and summary["failed"] == 0
+        scenario = next(p for p in lines if p.get("scenario") == "serve-drain")
+        assert scenario["clean_exit"] is True
+        assert scenario["completed"] == scenario["submitted"] > 0
+        # two tiny subprocesses (1 trainer + 1 serve under SIGTERM);
+        # ~4x headroom for CI contention
+        assert elapsed < 240, f"serve-drain smoke took {elapsed:.0f}s"
+
+
 @pytest.mark.slow
 class TestToolsRunOnCpu:
     def test_loader_scale_two_processes(self):

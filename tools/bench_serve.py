@@ -1,0 +1,216 @@
+"""Serving-plane load bench: bursty trace replay, cold vs warm start.
+
+ROADMAP item 1's measurement: the continuous-batching sampler server
+(`dcgan_tpu/serve`, ISSUE 9) replaying a heavy-traffic arrival trace —
+Poisson steady-state with a burst segment at several times the base rate
+— against one persistent compile cache, twice:
+
+  arm "cold": fresh cache dir — every bucket program compiles, the cache
+      is primed (the first-deploy cost);
+  arm "warm": same cache dir — the restart path: bucket programs
+      deserialize, cold-start drops to restore + bounded IO.
+
+and emits ONE BENCH-style JSON line: per-arm p50/p99 request latency,
+samples/sec/chip, queue depth, the cold-start breakdown, compile-cache
+hit counters, and the pass/fail of the invariants the serving plane
+exists to hold:
+
+  - zero sampler recompiles after the AOT bucket warmup on BOTH arms
+    (every served batch hits a precompiled bucket);
+  - the warm arm's cache has zero misses and nonzero hits (the restart
+    actually deserialized);
+  - every submitted request completed (the drain contract under a finite
+    trace).
+
+`--smoke` shrinks the model, trace, and budgets to the tier-1 pin
+(tests/test_tools.py, the chaos-marker pattern); the full-size run is
+the standalone capture. CPU-only by design — the serving economics
+story on chips comes from the module tracks; this tool certifies the
+MECHANISM.
+
+    JAX_PLATFORMS=cpu python tools/bench_serve.py [--smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _make_ckpt(ckpt_dir: str, workdir: str, *, size: int, batch: int,
+               timeout: float) -> None:
+    """One tiny trainer run to produce the checkpoint both arms serve."""
+    argv = [
+        sys.executable, "-m", "dcgan_tpu.train",
+        "--synthetic", "--max_steps", "1",
+        "--batch_size", str(batch), "--output_size", str(size),
+        "--gf_dim", "8", "--df_dim", "8",
+        "--sample_every_steps", "0", "--activation_summary_steps", "0",
+        "--save_summaries_secs", "0", "--save_model_secs", "1e9",
+        "--no_tensorboard",
+        "--checkpoint_dir", ckpt_dir,
+        "--sample_dir", os.path.join(workdir, "samples"),
+    ]
+    res = subprocess.run(argv, cwd=REPO,
+                         env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                         capture_output=True, text=True, timeout=timeout)
+    if res.returncode != 0:
+        raise RuntimeError(f"checkpoint trainer rc={res.returncode}: "
+                           f"{(res.stderr or '')[-800:]}")
+
+
+def make_trace(path: str, *, requests: int, rps: float, burst_factor: float,
+               burst_frac: float, max_images: int, seed: int) -> dict:
+    """Deterministic Poisson arrivals with a mid-trace burst segment at
+    burst_factor x the base rate — the 'heavy traffic' shape: steady
+    load, then a spike that must ride the batcher + backpressure instead
+    of a queue blowup. Returns the trace summary."""
+    rng = np.random.default_rng(seed)
+    burst_start = int(requests * (0.5 - burst_frac / 2))
+    burst_end = int(requests * (0.5 + burst_frac / 2))
+    t = 0.0
+    arrivals = []
+    for i in range(requests):
+        rate = rps * (burst_factor if burst_start <= i < burst_end else 1.0)
+        t += float(rng.exponential(1e3 / rate))
+        arrivals.append({"t_ms": t,
+                         "num_images": int(rng.integers(1, max_images + 1))})
+    with open(path, "w") as f:
+        json.dump({"arrivals": arrivals}, f)
+    return {"requests": requests,
+            "images": sum(a["num_images"] for a in arrivals),
+            "span_ms": round(t, 1),
+            "burst": {"factor": burst_factor,
+                      "requests": burst_end - burst_start}}
+
+
+def _run_arm(name: str, *, ckpt_dir: str, cache_dir: str, trace: str,
+             workdir: str, max_batch: int, max_wait_ms: float,
+             timeout: float) -> dict:
+    report = os.path.join(workdir, f"report-{name}.json")
+    argv = [
+        sys.executable, "-m", "dcgan_tpu.serve",
+        "--checkpoint_dir", ckpt_dir,
+        "--compile_cache_dir", cache_dir,
+        "--trace", trace,
+        "--max_batch", str(max_batch),
+        "--max_wait_ms", str(max_wait_ms),
+        "--report", report,
+        "--platform", "cpu",
+    ]
+    t0 = time.perf_counter()
+    res = subprocess.run(argv, cwd=REPO,
+                         env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                         capture_output=True, text=True, timeout=timeout)
+    if res.returncode != 0:
+        raise RuntimeError(f"{name} serve rc={res.returncode}: "
+                           f"{(res.stdout or '')[-400:]} "
+                           f"{(res.stderr or '')[-800:]}")
+    with open(report) as f:
+        row = json.load(f)
+    row["process_wall_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+    return row
+
+
+def _arm_summary(r: dict) -> dict:
+    return {
+        "p50_ms": r.get("serve/p50_ms"),
+        "p99_ms": r.get("serve/p99_ms"),
+        "samples_per_sec_chip": round(
+            r.get("serve/samples_per_sec", 0.0) / max(1, r["devices"]), 2),
+        "queue_depth_max": r.get("serve/queue_depth_max"),
+        "pad_frac": round(r.get("serve/pad_frac", 0.0), 4),
+        "batches": r.get("serve/batches"),
+        "completed": r.get("serve/completed"),
+        "dropped": r.get("serve/dropped"),
+        "cold_start_ms": round(r.get("serve/cold_start_ms", 0.0), 1),
+        "restore_ms": round(r.get("serve/restore_ms", 0.0), 1),
+        "warmup_ms": round(r.get("serve/warmup_ms", 0.0), 1),
+        "recompiles_after_warmup": r.get("serve/recompiles_after_warmup"),
+        "cache": {k: int(r.get(f"perf/compile_cache_{k}", 0))
+                  for k in ("requests", "hits", "misses")},
+        "process_wall_ms": r["process_wall_ms"],
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny model + short trace (the tier-1 pin)")
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-subprocess budget (seconds)")
+    args = ap.parse_args()
+    if args.smoke:
+        size, batch, requests, rps, max_images = 16, 8, 24, 40.0, 8
+        max_batch, max_wait_ms = 16, 5.0
+    else:
+        size, batch, requests, rps, max_images = 64, 16, 200, 50.0, 16
+        max_batch, max_wait_ms = 64, 10.0
+
+    with tempfile.TemporaryDirectory() as tmp:
+        ckpt = os.path.join(tmp, "ckpt")
+        cache = os.path.join(tmp, "compile-cache")
+        trace = os.path.join(tmp, "trace.json")
+        _make_ckpt(ckpt, tmp, size=size, batch=batch, timeout=args.timeout)
+        trace_meta = make_trace(trace, requests=requests, rps=rps,
+                                burst_factor=8.0, burst_frac=0.25,
+                                max_images=max_images, seed=0)
+        cold = _run_arm("cold", ckpt_dir=ckpt, cache_dir=cache, trace=trace,
+                        workdir=tmp, max_batch=max_batch,
+                        max_wait_ms=max_wait_ms, timeout=args.timeout)
+        warm = _run_arm("warm", ckpt_dir=ckpt, cache_dir=cache, trace=trace,
+                        workdir=tmp, max_batch=max_batch,
+                        max_wait_ms=max_wait_ms, timeout=args.timeout)
+
+    c, w = _arm_summary(cold), _arm_summary(warm)
+    checks = {
+        # every served batch hit a precompiled bucket — on both arms
+        "cold_zero_recompiles_after_warmup":
+            c["recompiles_after_warmup"] == 0,
+        "warm_zero_recompiles_after_warmup":
+            w["recompiles_after_warmup"] == 0,
+        # the warm restart actually deserialized from the primed cache
+        "warm_has_hits": w["cache"]["hits"] > 0,
+        "warm_zero_misses": w["cache"]["misses"] == 0,
+        "cold_has_misses": c["cache"]["misses"] > 0,
+        # finite trace + drain: nothing lost, nothing left queued
+        "cold_all_completed": c["completed"] == requests
+                              and c["dropped"] == 0,
+        "warm_all_completed": w["completed"] == requests
+                              and w["dropped"] == 0,
+        "latency_percentiles_present":
+            bool(c["p50_ms"] and c["p99_ms"] and w["p50_ms"]
+                 and w["p99_ms"]),
+    }
+    row = {
+        "label": "bench-serve",
+        "platform": "cpu",
+        "model": f"dcgan{size}",
+        "buckets": cold.get("buckets"),
+        "trace": trace_meta,
+        "cold": c,
+        "warm": w,
+        "speedup": {
+            "warmup_ms": round(c["warmup_ms"] / max(w["warmup_ms"], 1e-9),
+                               2),
+            "cold_start_ms": round(
+                c["cold_start_ms"] / max(w["cold_start_ms"], 1e-9), 2),
+        },
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    print(json.dumps(row))
+    sys.exit(0 if row["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,12 @@ never silently trains garbage, never hangs.
                                                          entry point, run
                                                          completes with zero
                                                          trips (ISSUE 8)
+    serve-drain           SIGTERM mid-load to the        intake stops, every
+                          sampler server                 in-flight/queued
+                          (`python -m dcgan_tpu.serve`)  request completes,
+                                                         queue drains, report
+                                                         lands, clean exit 0
+                                                         (ISSUE 9)
 
 Multi-host matrix (ISSUE 4, `--multihost`): the same contract under a REAL
 2-process jax.distributed job over localhost gRPC (tests/multihost_worker.py
@@ -453,8 +459,78 @@ def scenario_thread_checks(root: str) -> dict:
     return {"tripwire_armed": True, "trips": 0, "final_step": 6}
 
 
+def scenario_serve_drain(root: str) -> dict:
+    """SIGTERM mid-load to the serving plane (ISSUE 9) -> the graceful
+    drain contract: intake stops, every already-submitted request
+    completes (none dropped, none stranded), the report row lands, and
+    the process exits 0 — a preemption notice becomes a clean handoff.
+    The demo load is sized so the signal always lands mid-trace."""
+    import signal
+    import threading
+    import time
+
+    ck = os.path.join(root, "ck")
+    rc, out = _run_train(
+        dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+             save_model_secs=1e9),
+        max_steps=1)
+    _check(rc == 0, f"checkpoint trainer failed (rc={rc}): {out[-800:]}")
+
+    report = os.path.join(root, "serve-report.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("DCGAN_CHAOS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dcgan_tpu.serve",
+         "--checkpoint_dir", ck, "--max_batch", "8", "--max_wait_ms", "20",
+         "--demo_requests", "2000", "--demo_rps", "25",
+         "--report", report, "--platform", "cpu"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    lines = []
+    reader = threading.Thread(
+        target=lambda: [lines.append(l) for l in proc.stdout], daemon=True)
+    reader.start()
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline \
+                and not any("warm: serving" in l for l in lines):
+            if proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        _check(any("warm: serving" in l for l in lines),
+               f"server never turned warm: {''.join(lines)[-800:]}")
+        time.sleep(1.5)           # let some of the load land first
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    reader.join(timeout=10)
+    out = "".join(lines)
+    _check(rc == 0, f"serve exited rc={rc} after SIGTERM: {out[-800:]}")
+    _check("received signal 15" in out,
+           f"no signal acknowledgement: {out[-800:]}")
+    _check("drain:" in out and "clean exit" in out,
+           f"no drain summary line: {out[-800:]}")
+    _check(os.path.exists(report), "no report row written after the drain")
+    with open(report) as f:
+        row = json.load(f)
+    _check(row["interrupted"] is True, f"report not marked interrupted: "
+           f"{row}")
+    _check(0 < row["submitted"] < 2000,
+           f"signal did not land mid-load (submitted={row['submitted']})")
+    _check(row["completed"] == row["submitted"],
+           f"in-flight requests lost: submitted {row['submitted']}, "
+           f"completed {row['completed']}")
+    _check(row["serve/dropped"] == 0,
+           f"drain dropped requests: {row['serve/dropped']}")
+    return {"submitted": row["submitted"], "completed": row["completed"],
+            "unsubmitted": row["unsubmitted"], "clean_exit": True}
+
+
 SCENARIOS = {
     "nan-rollback": scenario_nan_rollback,
+    "serve-drain": scenario_serve_drain,
     "thread-checks": scenario_thread_checks,
     "pipeline-rollback": scenario_pipeline_rollback,
     "corrupt-record": scenario_corrupt_record,
