@@ -1,8 +1,10 @@
-"""Invariant analyzer (ISSUE 8): static AST lint + runtime tripwire.
+"""Invariant analyzer: static AST lint, runtime tripwire, semantic tier.
 
-The contracts that keep seven PRs of concurrency, donation, and parity
+The contracts that keep ten PRs of concurrency, donation, and parity
 machinery correct live here as executable checks instead of docstring
-folklore:
+folklore. Two static tiers plus a runtime tripwire:
+
+AST tier (ISSUE 8 — no imports of the code under analysis, milliseconds):
 
     DCG001  collectives only on the dispatch thread   analysis/threads.py
     DCG002  no donating non-XLA-owned buffers         analysis/donation.py
@@ -11,12 +13,24 @@ folklore:
     DCG005  no wall-clock/host-RNG in traced bodies   analysis/hygiene.py
     DCG006  retry-wrapped IO in services/checkpoint   analysis/hygiene.py
 
-Surface: `python -m dcgan_tpu.analysis [--json] [--baseline FILE]
-[paths...]` — exit 1 on any non-baselined finding. Per-line suppression:
-`# dcg: disable=DCG005`. Committed exemptions: analysis/baseline.jsonl
-(every entry carries a `why`). The runtime half is analysis/tripwire.py
-(`DCGAN_THREAD_CHECKS=1`), armed across tier-1 by tests/conftest.py.
-See docs/DESIGN.md §7b for the full invariant catalog.
+Semantic tier (ISSUE 11 — imports, builds, and `.lower()`s every program
+the repo can dispatch on a canonical CPU topology; `--semantic`):
+
+    DCG007  donation realized as input_output_aliases analysis/semantic.py
+    DCG008  collective census + program manifest      analysis/semantic.py
+    DCG009  retrace hazards + warmup-plan coverage    analysis/semantic.py
+    DCG010  traced-body hygiene (callbacks/f64/...)   analysis/semantic.py
+
+Surface: `python -m dcgan_tpu.analysis [--semantic] [--json] [--baseline
+FILE] [paths...]` — exit 1 on any non-baselined finding. Per-line
+suppression (AST tier): `# dcg: disable=DCG005`. Committed exemptions
+(both tiers): analysis/baseline.jsonl (every entry carries a `why`). The
+semantic tier's committed contract is analysis/programs.lock.jsonl
+(program name -> call shapes -> jaxpr fingerprint -> collective census ->
+donation map), regenerated via `--semantic --write-manifest`; any
+unexplained drift is a DCG008 finding. The runtime half is
+analysis/tripwire.py (`DCGAN_THREAD_CHECKS=1`), armed across tier-1 by
+tests/conftest.py. See docs/DESIGN.md §7b/§7c for the invariant catalog.
 """
 
 from dcgan_tpu.analysis.core import (  # noqa: F401

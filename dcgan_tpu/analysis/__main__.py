@@ -1,12 +1,26 @@
-"""CLI: `python -m dcgan_tpu.analysis [--json] [--baseline FILE] [paths...]`.
+"""CLI: `python -m dcgan_tpu.analysis [--semantic] [--json] [paths...]`.
 
-Runs the six invariant checkers over the package (or the given paths),
-applies per-line suppressions and the committed baseline, prints the
-findings, and exits 1 if any NON-baselined finding remains — the tier-1
-contract (tests/test_tools.py pins a clean run).
+Two tiers behind one entry point and one exit contract (exit 1 on any
+non-baselined finding — tests/test_tools.py pins both clean):
+
+- default: the import-free AST tier (DCG001-006) over the package or the
+  given paths, milliseconds per run;
+- `--semantic`: the lowered-program tier (DCG007-010, ISSUE 11) — builds
+  and `.lower()`s every dispatchable program on the canonical CPU
+  topology, audits donation aliasing / collective census / retrace
+  hazards / traced-body hygiene, and compares the result against the
+  committed program manifest (analysis/programs.lock.jsonl).
+
+Semantic workflow:
+    python -m dcgan_tpu.analysis --semantic                  # check (CI pin)
+    python -m dcgan_tpu.analysis --semantic --write-manifest # regenerate the
+                                                             # committed lock
+    python -m dcgan_tpu.analysis --semantic --stream-table   # DESIGN §6c.1's
+                                                             # generated table
 
 `--write-baseline FILE` drafts baseline entries for the current findings
-(with `why` left as a TODO each entry must replace before review).
+(with `why` left as a TODO each entry must replace before review); the
+baseline file is shared by both tiers.
 """
 
 from __future__ import annotations
@@ -24,10 +38,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m dcgan_tpu.analysis",
         description="invariant analyzer: concurrency/donation/parity "
-                    "contract lint over the dcgan_tpu package")
+                    "contract lint (AST tier) and lowered-program "
+                    "contract audit (--semantic)")
     p.add_argument("paths", nargs="*",
                    help="files/directories to scan (default: the "
-                        "dcgan_tpu package)")
+                        "dcgan_tpu package; AST tier only)")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="one JSON object per finding + a summary line")
     p.add_argument("--baseline", default=None,
@@ -39,8 +54,47 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--write-baseline", default=None, metavar="FILE",
                    help="write the current findings as draft baseline "
                         "entries to FILE and exit 0")
+    p.add_argument("--semantic", action="store_true",
+                   help="run the lowered-program tier (DCG007-010) "
+                        "instead of the AST tier")
+    p.add_argument("--manifest", default=None, metavar="FILE",
+                   help="program manifest to check against (default: "
+                        "dcgan_tpu/analysis/programs.lock.jsonl)")
+    p.add_argument("--write-manifest", nargs="?", const="", default=None,
+                   metavar="FILE",
+                   help="with --semantic: (re)write the program manifest "
+                        "(default: the committed "
+                        "analysis/programs.lock.jsonl) — drift findings "
+                        "are moot while regenerating, every other "
+                        "finding still gates the exit code")
+    p.add_argument("--stream-table", action="store_true",
+                   help="with --semantic: print DESIGN §6c.1's generated "
+                        "dispatch-stream table from the live census and "
+                        "exit")
     args = p.parse_args(argv)
 
+    if (args.write_manifest is not None or args.stream_table
+            or args.manifest) and not args.semantic:
+        p.error("--write-manifest/--stream-table/--manifest require "
+                "--semantic")
+    if args.stream_table and args.write_manifest is not None:
+        # --stream-table is a pure printer (its stdout is pasted into
+        # DESIGN §6c.1) and returns 0 unconditionally; silently swallowing
+        # --write-manifest's finding-gated exit under it would let a
+        # DCG007-010 regression ship — run the two steps separately
+        p.error("--stream-table and --write-manifest cannot be combined "
+                "(the table printer exits 0 regardless of findings); run "
+                "--write-manifest first, then --stream-table")
+    if args.semantic and args.paths:
+        p.error("--semantic audits the dispatchable-program enumeration, "
+                "not source paths")
+
+    if args.semantic:
+        return _run_semantic(p, args)
+    return _run_ast(p, args)
+
+
+def _run_ast(p: argparse.ArgumentParser, args) -> int:
     root = core.default_root()
     paths = args.paths or [os.path.join(root, "dcgan_tpu")]
     try:  # bad path / unknown --checks ID: usage error, not a traceback
@@ -51,23 +105,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.error(str(e))
 
     if args.write_baseline is not None:
-        with open(args.write_baseline, "w", encoding="utf-8") as f:
-            for finding in findings:
-                f.write(json.dumps(finding.baseline_entry()) + "\n")
-        print(f"wrote {len(findings)} draft baseline entr"
-              f"{'y' if len(findings) == 1 else 'ies'} to "
-              f"{args.write_baseline} (fill in each 'why')")
-        return 0
+        return _write_baseline(args.write_baseline, findings)
 
-    baseline_path = args.baseline if args.baseline is not None \
-        else core.default_baseline_path()
-    try:  # malformed entry / draft TODO why: a clean error, not a dump
-        baseline = core.load_baseline(baseline_path) if baseline_path \
-            else []
-    except ValueError as e:
-        p.error(str(e))
-    new, old = core.split_baselined(findings, baseline)
-
+    new, old = _apply_baseline(p, args, findings)
     if args.as_json:
         for finding in new:
             print(json.dumps(finding.to_json()))
@@ -83,6 +123,74 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"{len(new)} new finding(s), {len(old)} baselined"
               + ("" if new else " — clean"))
     return 1 if new else 0
+
+
+def _run_semantic(p: argparse.ArgumentParser, args) -> int:
+    # topology first, BEFORE anything can initialize jax: the census needs
+    # >= 2 CPU devices (collectives over a size-1 axis trace away) and the
+    # committed fingerprints assume partitionable threefry
+    from dcgan_tpu.analysis import semantic
+
+    semantic.ensure_semantic_platform()
+    from dcgan_tpu.analysis import manifest as manifest_lib
+
+    writing = args.write_manifest is not None
+    try:
+        findings, records = semantic.run_semantic(
+            checks=args.checks, manifest_path=args.manifest,
+            # drift against the old manifest is moot while regenerating it
+            compare_manifest=not writing)
+    except (ValueError, RuntimeError) as e:
+        p.error(str(e))
+
+    if args.stream_table:  # pure printer (mutually exclusive with writing)
+        print(manifest_lib.render_stream_table(records))
+        return 0
+    if writing:
+        path = args.write_manifest or manifest_lib.default_manifest_path()
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(manifest_lib.dumps(records))
+        print(f"wrote {len(records)} manifest row(s) to {path}")
+    if args.write_baseline is not None:
+        return _write_baseline(args.write_baseline, findings)
+
+    new, old = _apply_baseline(p, args, findings)
+    if args.as_json:
+        for finding in new:
+            print(json.dumps(finding.to_json()))
+        print(json.dumps({
+            "label": "dcgan-analysis-semantic", "programs": len(records),
+            "findings": len(findings), "baselined": len(old),
+            "new_findings": len(new)}))
+    else:
+        for finding in new:
+            print(f"{finding.path}: {finding.check} "
+                  f"[{finding.symbol}] {finding.message}")
+        print(f"[dcgan_tpu.analysis --semantic] {len(records)} "
+              f"program(s), {len(new)} new finding(s), {len(old)} "
+              f"baselined" + ("" if new else " — clean"))
+    return 1 if new else 0
+
+
+def _write_baseline(path: str, findings) -> int:
+    with open(path, "w", encoding="utf-8") as f:
+        for finding in findings:
+            f.write(json.dumps(finding.baseline_entry()) + "\n")
+    print(f"wrote {len(findings)} draft baseline entr"
+          f"{'y' if len(findings) == 1 else 'ies'} to "
+          f"{path} (fill in each 'why')")
+    return 0
+
+
+def _apply_baseline(p: argparse.ArgumentParser, args, findings):
+    baseline_path = args.baseline if args.baseline is not None \
+        else core.default_baseline_path()
+    try:  # malformed entry / draft TODO why: a clean error, not a dump
+        baseline = core.load_baseline(baseline_path) if baseline_path \
+            else []
+    except ValueError as e:
+        p.error(str(e))
+    return core.split_baselined(findings, baseline)
 
 
 if __name__ == "__main__":

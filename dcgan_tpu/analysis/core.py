@@ -313,8 +313,16 @@ def run_checks(sources: Sequence[SourceFile], config: Optional[Config] = None,
         checks = [c.upper() for c in checks]
         unknown = sorted(set(checks) - set(registry))
         if unknown:
+            from dcgan_tpu.analysis.semantic import SEMANTIC_CHECKS
+
+            if set(unknown) <= set(SEMANTIC_CHECKS):
+                raise ValueError(
+                    f"{unknown} are semantic-tier check ID(s) — run "
+                    "`python -m dcgan_tpu.analysis --semantic --checks "
+                    + " ".join(unknown) + "`")
             raise ValueError(
-                f"unknown check ID(s) {unknown}; valid: {sorted(registry)}")
+                f"unknown check ID(s) {unknown}; valid: {sorted(registry)}"
+                f" (AST tier) + {list(SEMANTIC_CHECKS)} (--semantic)")
     by_path = {sf.path: sf for sf in sources}
     findings: List[Finding] = []
     for check_id in checks or sorted(registry):

@@ -1,0 +1,664 @@
+"""Semantic tier (ISSUE 11): contracts checked in the LOWERED programs.
+
+The AST tier (core.py + the DCG001-006 checkers) polices source without
+importing it; this tier deliberately does the opposite — it imports,
+builds, and `.lower()`s every program the repo can dispatch, on CPU at a
+small preset, and checks the contracts that only exist after tracing:
+
+    DCG007  donation realized as aliasing     check_donation
+    DCG008  collective census vs the manifest check_manifest/check_transports
+    DCG009  retrace hazards + warmup coverage check_warmup_coverage/check_retrace
+    DCG010  traced-body hygiene               check_hygiene
+
+The enumeration is the repo's real dispatch surface: both ParallelTrain
+backends' `programs` dicts through the AOT warmup plan (train/warmup.py —
+including the k=1 tail, the `steps_per_call` scan, and the LR-backoff
+rebuild variants), the `--pipeline_gd` stage programs, and the serving
+plane's bucket-ladder sampler rungs (serve/buckets.py). Host-side
+coordination transports (`process_allgather` is opaque to `.lower()`)
+join the manifest as declared rows from
+train/coordination.py::TRANSPORT_CENSUS.
+
+Everything is computed on one canonical topology — CPU, 2 virtual
+devices, a 2-way "data" mesh, partitionable threefry — because the
+committed manifest (analysis/programs.lock.jsonl) is byte-reproducible by
+contract. Two devices, not one: collectives over a size-1 axis are elided
+at trace time, so a 1-device census would be structurally empty. The CLI
+(`python -m dcgan_tpu.analysis --semantic`) arranges the topology before
+jax initializes; in-process callers must already satisfy it
+(tests/conftest.py's 8-virtual-device env does — the mesh only takes the
+first two devices, and the jaxprs are identical).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dcgan_tpu.analysis import manifest as manifest_lib
+from dcgan_tpu.analysis.core import Finding
+
+SEMANTIC_CHECKS = ("DCG007", "DCG008", "DCG009", "DCG010")
+
+#: devices the canonical topology forces / the enumeration's mesh uses
+CANONICAL_DEVICES = 2
+
+#: serve bucket ladder top rung for the enumeration (granule = the data
+#: axis, so the default doubling ladder is 2, 4, 8 — three compiled rungs,
+#: the shape set `serve.buckets.build_ladder` produces for this preset)
+SERVE_MAX_BATCH = 8
+
+#: jaxpr primitive -> canonical census op. `psum2`/`all_gather_invariant`
+#: are the names the experimental shard_map check_rep rewriter gives the
+#: user-written collectives in this container's jax 0.4.37 — same ops,
+#: rewritten for replication tracking.
+CENSUS_PRIMS = {
+    "psum": "psum", "psum2": "psum",
+    "all_gather": "all_gather", "all_gather_invariant": "all_gather",
+    "reduce_scatter": "reduce_scatter", "psum_scatter": "reduce_scatter",
+    "ppermute": "ppermute", "all_to_all": "all_to_all",
+    "pmax": "pmax", "pmin": "pmin",
+}
+
+#: DCG010: host-callback primitives — a callback inside a dispatched
+#: program re-enters Python from the runtime (ordering hazards against the
+#: async dispatch stream, catastrophic on real meshes)
+CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                  "host_callback_call", "outside_call", "python_callback"}
+
+#: DCG010: explicit transfer primitives inside traced code
+TRANSFER_PRIMS = {"device_put"}
+
+#: DCG009: closure-captured consts above this element count are flagged —
+#: an array baked into the program bloats every retrace and defeats the
+#: persistent-cache key (the array's VALUE is in the HLO)
+CONST_SIZE_LIMIT = 64
+
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+#: where findings for each enumeration group anchor
+GROUP_PATHS = {
+    "gspmd": "dcgan_tpu/parallel/api.py",
+    "shard_map": "dcgan_tpu/parallel/shard_map_backend.py",
+    "serve": "dcgan_tpu/serve/buckets.py",
+    "coordination": "dcgan_tpu/train/coordination.py",
+}
+
+
+def ensure_semantic_platform() -> None:
+    """Arrange the canonical topology. Must run before jax initializes —
+    the CLI calls it first; tools embedding the tier should too."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None or int(m.group(1)) < CANONICAL_DEVICES:
+        # no ambient count, or one too small for the census (an ambient
+        # `=1` is common in CPU dev shells and would elide every
+        # collective at trace time) — rewrite it; a LARGER ambient count
+        # (the 8-device test env) is left alone, the mesh only takes the
+        # first CANONICAL_DEVICES devices either way
+        if m is not None:
+            flags = flags.replace(m.group(0), "")
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{CANONICAL_DEVICES}").strip()
+    import jax
+
+    # the ambient environment may have force-selected a platform at
+    # interpreter startup (config beats env var) — override it back
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+
+
+def _require_platform() -> None:
+    """The enumeration refuses to run on a non-canonical topology rather
+    than produce fingerprints that can never match the manifest."""
+    import jax
+
+    devs = jax.devices()
+    problems = []
+    if devs[0].platform != "cpu":
+        problems.append(f"platform is {devs[0].platform!r}, need cpu")
+    if len(devs) < CANONICAL_DEVICES:
+        problems.append(f"{len(devs)} device(s), need >= "
+                        f"{CANONICAL_DEVICES} (collectives over a size-1 "
+                        "axis are elided at trace time)")
+    if not jax.config.jax_threefry_partitionable:
+        problems.append("jax_threefry_partitionable is off (RNG lowering "
+                        "differs, fingerprints cannot match)")
+    if problems:
+        raise RuntimeError(
+            "semantic tier needs the canonical topology — "
+            + "; ".join(problems)
+            + ". Run via `python -m dcgan_tpu.analysis --semantic` (it "
+            "arranges the environment before jax initializes).")
+
+
+def small_config(backend: str = "gspmd", pipeline: bool = False):
+    """The small CPU preset every program is lowered at: tiny dcgan16
+    model, global batch 8 over the 2-way data mesh, every optional
+    program's knob armed (sampler / probe / summarize / rollback with LR
+    backoff) so the warmup plan enumerates the full dispatch surface."""
+    from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+
+    return TrainConfig(
+        model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                          compute_dtype="float32"),
+        mesh=MeshConfig(data=CANONICAL_DEVICES),
+        batch_size=8,
+        backend=backend,
+        # pipeline_gd is config-validated to steps_per_call=1; the plain
+        # variant scans k=2 so the multi_step program joins the manifest
+        steps_per_call=1 if pipeline else 2,
+        pipeline_gd=pipeline,
+        sample_every_steps=100,
+        activation_summary_steps=100,
+        nan_check_steps=100,
+        nan_policy="rollback",
+        rollback_snapshot_steps=100,
+        rollback_lr_backoff=0.5,
+        tensorboard=False)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramAudit:
+    """Everything the checkers need about one lowered program."""
+
+    name: str              # "gspmd::train_step", "serve::sampler@b4", ...
+    path: str              # repo-relative path findings anchor to
+    args: Tuple[str, ...]  # short per-argument signatures
+    fingerprint: str       # sha256[:16] of the sanitized jaxpr text
+    collectives: Dict[str, int]
+    donation: Optional[Dict[str, object]]   # None when nothing is donated
+    expect_donation: bool
+    consts: Tuple[Tuple[str, int, str, bool], ...]  # (label, size, dtype,
+                                                    #  weak_type)
+    callbacks: Tuple[str, ...]   # callback primitive names found
+    transfers: Tuple[str, ...]   # transfer primitive names found
+    f64_prims: Tuple[str, ...]   # primitives with float64/complex128 out
+    cadence: str = ""
+
+    @property
+    def base(self) -> str:
+        """Program name without the group / @shape qualifiers."""
+        return self.name.split("::", 1)[-1].split("@", 1)[0]
+
+
+def _walk_jaxpr(jaxpr, visit) -> None:
+    """visit(eqn) over every equation, recursing into sub-jaxprs (scan
+    bodies, pjit calls, shard_map bodies, cond branches, custom-vjp
+    closures — anything whose params carry a Jaxpr/ClosedJaxpr)."""
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for v in eqn.params.values():
+            for j in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(j, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    _walk_jaxpr(inner, visit)
+                elif hasattr(j, "eqns"):
+                    _walk_jaxpr(j, visit)
+
+
+def _arg_sig(x) -> str:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(x)
+    if len(leaves) != 1 or leaves[0] is not x:
+        return f"tree({len(leaves)} leaves)"
+    try:
+        from jax.api_util import shaped_abstractify
+    except ImportError:  # moved in newer jax
+        from jax._src.api_util import shaped_abstractify
+    return shaped_abstractify(leaves[0]).str_short()
+
+
+def _alias_param_numbers(hlo_text: str) -> Set[int]:
+    """Entry-parameter numbers in the compiled module's
+    `input_output_alias={ {out}: (param, {index}, kind), ... }` map."""
+    i = hlo_text.find("input_output_alias={")
+    if i < 0:
+        return set()
+    j = i + len("input_output_alias=")
+    depth = 0
+    end = None
+    for k in range(j, len(hlo_text)):
+        if hlo_text[k] == "{":
+            depth += 1
+        elif hlo_text[k] == "}":
+            depth -= 1
+            if depth == 0:
+                end = k + 1
+                break
+    if end is None:
+        return set()
+    return {int(m.group(1)) for m in
+            re.finditer(r":\s*\(\s*(\d+)\s*,", hlo_text[j:end])}
+
+
+def audit_callable(name: str, fn, args: tuple, *, path: str,
+                   expect_donation: bool = False,
+                   cadence: str = "") -> ProgramAudit:
+    """Trace + lower (+ compile, iff anything is donated) one program and
+    extract the audited facts. `fn` is a jitted callable (tripwire
+    wrappers forward `.trace`/`.lower`); `args` are example arguments —
+    ShapeDtypeStructs are fine, nothing is executed."""
+    import jax.tree_util as jtu
+
+    traced = fn.trace(*args)
+    closed = traced.jaxpr
+
+    census: Dict[str, int] = {}
+    callbacks: List[str] = []
+    transfers: List[str] = []
+    f64: List[str] = []
+
+    def visit(eqn):
+        prim = eqn.primitive.name
+        op = CENSUS_PRIMS.get(prim)
+        if op is not None:
+            census[op] = census.get(op, 0) + 1
+        if prim in CALLBACK_PRIMS or (prim not in CENSUS_PRIMS
+                                      and "callback" in prim):
+            callbacks.append(prim)
+        if prim in TRANSFER_PRIMS:
+            transfers.append(prim)
+        for ov in eqn.outvars:
+            dt = getattr(getattr(ov, "aval", None), "dtype", None)
+            if dt is not None and str(dt) in ("float64", "complex128"):
+                f64.append(prim)
+                break
+
+    _walk_jaxpr(closed.jaxpr, visit)
+
+    consts: List[Tuple[str, int, str, bool]] = []
+    for i, c in enumerate(closed.consts):
+        aval = getattr(c, "aval", None)
+        shape = tuple(getattr(c, "shape", ()))
+        size = 1
+        for d in shape:
+            size *= int(d)
+        dtype = str(getattr(c, "dtype", "?"))
+        weak = bool(getattr(aval, "weak_type", False))
+        label = f"const{i}:{dtype}{list(shape)}"
+        consts.append((label, size, dtype, weak))
+
+    fingerprint = hashlib.sha256(
+        _ADDR_RE.sub("0x", str(closed)).encode()).hexdigest()[:16]
+
+    import warnings
+
+    with warnings.catch_warnings():
+        # the audit below IS the actionable form of this lowering warning
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        # lower the Traced we already have — fn.lower(*args) would re-trace
+        # every program from scratch (tracing dominates enumeration cost)
+        lowered = traced.lower()
+    flat_info, _ = jtu.tree_flatten(lowered.args_info)
+    donated = [i for i, a in enumerate(flat_info) if a.donated]
+    donation: Optional[Dict[str, object]] = None
+    if donated:
+        labels = [jtu.keystr(p) for p, _ in
+                  jtu.tree_flatten_with_path(lowered.args_info)[0]]
+        try:
+            kept = sorted(lowered._lowering.compile_args["kept_var_idx"])
+        except Exception:  # internals moved: assume nothing was pruned
+            kept = list(range(len(flat_info)))
+        compiled = lowered.compile()
+        aliased_flat = {kept[p] for p in
+                        _alias_param_numbers(compiled.as_text())
+                        if p < len(kept)}
+        kept_set = set(kept)
+        donation = {
+            "donated": len(donated),
+            "aliased": len(aliased_flat & set(donated)),
+            "pruned": sum(1 for i in donated if i not in kept_set),
+            "unaliased": sorted(labels[i] for i in donated
+                                if i in kept_set
+                                and i not in aliased_flat),
+        }
+
+    return ProgramAudit(
+        name=name, path=path, args=tuple(_arg_sig(a) for a in args),
+        fingerprint=fingerprint,
+        collectives=dict(sorted(census.items())), donation=donation,
+        expect_donation=expect_donation, consts=tuple(consts),
+        callbacks=tuple(sorted(set(callbacks))),
+        transfers=tuple(sorted(set(transfers))),
+        f64_prims=tuple(sorted(set(f64))), cadence=cadence)
+
+
+@dataclasses.dataclass(frozen=True)
+class CoverageRow:
+    """One config variant's dispatch surface vs its warmup plan (DCG009):
+    `programs` is the ParallelTrain programs-dict key set, `plan` the
+    warmup plan's row names, `must_cover` the names the trainer loop
+    dispatches at THAT config (so the plan must contain them)."""
+
+    variant: str
+    path: str
+    programs: frozenset
+    plan: Tuple[str, ...]
+    must_cover: frozenset
+
+
+def _base(name: str) -> str:
+    return name.split("@", 1)[0]
+
+
+def enumerate_audits() -> Tuple[List[ProgramAudit], List[CoverageRow]]:
+    """Lower the full dispatch surface at the small preset. Order is
+    deterministic; the returned audits are the manifest's program rows."""
+    _require_platform()
+    import jax
+    import jax.numpy as jnp
+
+    from dcgan_tpu.parallel import make_mesh, make_parallel_train
+    from dcgan_tpu.parallel.api import DONATED_PROGRAMS
+    from dcgan_tpu.serve.buckets import build_ladder, sampler_plan
+    from dcgan_tpu.train import warmup
+
+    devices = jax.devices()[:CANONICAL_DEVICES]
+    audits: List[ProgramAudit] = []
+    coverage: List[CoverageRow] = []
+    serve_rows: List[Tuple[str, object, tuple]] = []
+
+    for backend in ("gspmd", "shard_map"):
+        path = GROUP_PATHS[backend]
+        cfg = small_config(backend)
+        mesh = make_mesh(cfg.mesh, devices)
+        pt = make_parallel_train(cfg, mesh)
+        state = warmup.state_example(pt)
+        z = jax.ShapeDtypeStruct((cfg.batch_size, cfg.model.z_dim),
+                                 jnp.float32)
+        plan, _pt_backoff = warmup.build_warmup_plan(
+            cfg, pt, state, sample_z=z, eval_z=z,
+            make_backoff_pt=lambda c, _m=mesh: make_parallel_train(c, _m))
+        rows = [("init", pt.programs["init"], (jax.random.key(0),))]
+        rows += [(n, f, a) for n, f, a in plan]
+
+        cfg_p = small_config(backend, pipeline=True)
+        pt_p = make_parallel_train(cfg_p, mesh)
+        plan_p, _bk = warmup.build_warmup_plan(
+            cfg_p, pt_p, state, sample_z=None, eval_z=None,
+            make_backoff_pt=lambda c, _m=mesh: make_parallel_train(c, _m))
+        stages = ("gen_fakes", "d_update", "g_update")
+        rows += [(n, f, a) for n, f, a in plan_p if _base(n) in stages]
+
+        coverage.append(CoverageRow(
+            variant=backend, path=path,
+            programs=frozenset(pt.programs),
+            plan=tuple(n for n, _, _ in plan),
+            must_cover=frozenset(
+                {"train_step", f"multi_step@k{cfg.steps_per_call}",
+                 "sampler", "eval_losses", "summarize", "state_copy"})))
+        coverage.append(CoverageRow(
+            variant=f"{backend}+pipeline_gd", path=path,
+            programs=frozenset(pt_p.programs),
+            plan=tuple(n for n, _, _ in plan_p),
+            must_cover=frozenset(stages)))
+
+        for n, f, a in rows:
+            cadence = ""
+            if n == "train_step":
+                cadence = ("every step (default `steps_per_call`=1; a "
+                           "scanned run dispatches `multi_step`, census "
+                           "identical ×k)")
+            audits.append(audit_callable(
+                f"{backend}::{n}", f, a, path=path,
+                expect_donation=_base(n) in DONATED_PROGRAMS,
+                cadence=cadence))
+
+        if backend == "gspmd":
+            # the serving plane's rungs: the checkpoint-source sampler at
+            # every bucket of the default doubling ladder (granule = the
+            # data-axis size, the BucketLadder contract)
+            ladder = build_ladder(SERVE_MAX_BATCH, mesh.shape["data"])
+            serve_rows = sampler_plan(pt.sample, ladder, cfg.model.z_dim,
+                                      state=state)
+
+    for n, f, a in serve_rows:
+        audits.append(audit_callable(
+            f"serve::{n}", f, a, path=GROUP_PATHS["serve"],
+            expect_donation=False))
+    return audits, coverage
+
+
+# -- checkers ----------------------------------------------------------------
+
+def check_donation(audits: Sequence[ProgramAudit]) -> List[Finding]:
+    """DCG007: donation realized as aliasing, in both directions."""
+    findings: List[Finding] = []
+    for a in audits:
+        if a.donation is None:
+            if a.expect_donation:
+                findings.append(Finding(
+                    check="DCG007", path=a.path, line=0, symbol=a.name,
+                    key=f"undonated:{a.name}",
+                    message=f"{a.name} is declared a donating program "
+                            "(parallel/api.py::DONATED_PROGRAMS) but its "
+                            "compiled form donates nothing — the state "
+                            "update silently stopped being in-place"))
+            continue
+        if not a.expect_donation:
+            findings.append(Finding(
+                check="DCG007", path=a.path, line=0, symbol=a.name,
+                key=f"undeclared-donor:{a.name}",
+                message=f"{a.name} donates buffers but is not declared in "
+                        "parallel/api.py::DONATED_PROGRAMS — undeclared "
+                        "donors bypass the donation-safety discipline "
+                        "(DESIGN §6d); declare it and regenerate the "
+                        "manifest"))
+        for label in a.donation.get("unaliased", ()):
+            findings.append(Finding(
+                check="DCG007", path=a.path, line=0, symbol=a.name,
+                key=f"unaliased:{a.name}:{label}",
+                message=f"{a.name}: donated argument {label} is NOT "
+                        "realized as an input_output_aliases pair in the "
+                        "compiled executable — a silent copy every "
+                        "dispatch, and under deserialized-executable "
+                        "donation (DESIGN §6d) a latent heap hazard"))
+    return findings
+
+
+def check_transports() -> List[Finding]:
+    """DCG008 (registry half): every declared transport row must name a
+    live callable in train/coordination.py that the runtime tripwire
+    wraps — a renamed transport must fail here, not silently drop out of
+    the manifest."""
+    from dcgan_tpu.analysis import tripwire
+    from dcgan_tpu.train import coordination
+
+    findings: List[Finding] = []
+    path = GROUP_PATHS["coordination"]
+    for tname, (fn_name, census, _cadence) in sorted(
+            coordination.TRANSPORT_CENSUS.items()):
+        name = f"coordination::{tname}"
+        if not callable(getattr(coordination, fn_name, None)):
+            findings.append(Finding(
+                check="DCG008", path=path, line=0, symbol=name,
+                key=f"transport:{tname}",
+                message=f"TRANSPORT_CENSUS entry {tname!r} names "
+                        f"coordination.{fn_name}, which does not exist — "
+                        "the declared census no longer describes a live "
+                        "transport"))
+        if fn_name not in tripwire.WRAPPED_TRANSPORTS:
+            findings.append(Finding(
+                check="DCG008", path=path, line=0, symbol=name,
+                key=f"transport-unwrapped:{tname}",
+                message=f"transport {fn_name!r} (census entry {tname!r}) "
+                        "is not in the runtime tripwire's wrap list — a "
+                        "declared collective transport must also be "
+                        "thread-policed (analysis/tripwire.py)"))
+    return findings
+
+
+def transport_records() -> List[manifest_lib.ProgramRecord]:
+    from dcgan_tpu.train import coordination
+
+    return [manifest_lib.ProgramRecord(
+        name=f"coordination::{tname}", kind="transport",
+        path=GROUP_PATHS["coordination"], args=(fn_name,),
+        fingerprint="-", collectives=dict(census), donation=None,
+        cadence=cadence)
+        for tname, (fn_name, census, cadence) in
+        sorted(coordination.TRANSPORT_CENSUS.items())]
+
+
+def records_from(audits: Sequence[ProgramAudit]
+                 ) -> List[manifest_lib.ProgramRecord]:
+    return [manifest_lib.ProgramRecord(
+        name=a.name, kind="program", path=a.path, args=a.args,
+        fingerprint=a.fingerprint, collectives=dict(a.collectives),
+        donation=a.donation, cadence=a.cadence)
+        for a in audits] + transport_records()
+
+
+def check_warmup_coverage(coverage: Sequence[CoverageRow]) -> List[Finding]:
+    """DCG009 (coverage half): the warmup plan must enumerate what the
+    loop dispatches — per variant (`must_cover` rows present verbatim)
+    and per backend (every `programs`-dict entry except the pre-warmup
+    `init` planned by SOME variant). Generalizes PR 7's test-pinned
+    stage-coverage check to every program and both backends."""
+    findings: List[Finding] = []
+    planned_by_backend: Dict[str, Set[str]] = {}
+    programs_by_backend: Dict[str, Tuple[str, Set[str]]] = {}
+    for row in coverage:
+        backend = row.variant.split("+", 1)[0]
+        planned_by_backend.setdefault(backend, set()).update(
+            _base(n) for n in row.plan)
+        # UNION across the backend's variants: a program registered by
+        # only one variant's construction must still be planned somewhere
+        programs_by_backend.setdefault(backend, (row.path, set()))[1] \
+            .update(row.programs)
+        for want in sorted(row.must_cover):
+            if want not in row.plan:
+                findings.append(Finding(
+                    check="DCG009", path=row.path, line=0,
+                    symbol=f"{row.variant}::warmup_plan",
+                    key=f"warmup-gap:{row.variant}:{want}",
+                    message=f"[{row.variant}] the trainer loop dispatches "
+                            f"{want!r} at this config but the warmup plan "
+                            "does not enumerate it — its first live "
+                            "dispatch would compile under an armed "
+                            "watchdog deadline (DESIGN §6d)"))
+    for backend, (path, programs) in sorted(programs_by_backend.items()):
+        for prog in sorted(programs - {"init"}
+                           - planned_by_backend.get(backend, set())):
+            findings.append(Finding(
+                check="DCG009", path=path, line=0,
+                symbol=f"{backend}::warmup_plan",
+                key=f"warmup-unplanned:{backend}:{prog}",
+                message=f"[{backend}] ParallelTrain.programs[{prog!r}] is "
+                        "dispatchable but no warmup-plan variant ever "
+                        "enumerates it — AOT warmup cannot pre-compile "
+                        "what the plan does not name"))
+    return findings
+
+
+def check_retrace(audits: Sequence[ProgramAudit]) -> List[Finding]:
+    """DCG009 (hazard half): closure-captured constvars and weak-typed
+    (python-scalar) leakage in the traced programs."""
+    findings: List[Finding] = []
+    for a in audits:
+        for label, size, _dtype, weak in a.consts:
+            if size > CONST_SIZE_LIMIT:
+                findings.append(Finding(
+                    check="DCG009", path=a.path, line=0, symbol=a.name,
+                    key=f"const:{a.name}:{label}",
+                    message=f"{a.name} closes over {label} ({size} "
+                            "elements) as a baked-in constant — its VALUE "
+                            "is part of the HLO, so every change retraces "
+                            "and re-keys the persistent compile cache; "
+                            "pass it as an argument instead"))
+            elif weak:
+                findings.append(Finding(
+                    check="DCG009", path=a.path, line=0, symbol=a.name,
+                    key=f"weak-const:{a.name}:{label}",
+                    message=f"{a.name} closes over weak-typed {label} — a "
+                            "leaked python scalar whose promotion "
+                            "semantics differ from committed arrays; bind "
+                            "it with an explicit dtype"))
+    return findings
+
+
+def check_hygiene(audits: Sequence[ProgramAudit]) -> List[Finding]:
+    """DCG010: host callbacks, implicit f64 promotion, and explicit
+    transfers inside the traced bodies."""
+    findings: List[Finding] = []
+    for a in audits:
+        for prim in a.callbacks:
+            findings.append(Finding(
+                check="DCG010", path=a.path, line=0, symbol=a.name,
+                key=f"callback:{a.name}:{prim}",
+                message=f"{a.name} contains host callback {prim!r} — a "
+                        "dispatched program re-entering Python has no "
+                        "ordering against the async dispatch stream "
+                        "(DESIGN §6b) and stalls the device on the host"))
+        for prim in a.f64_prims:
+            findings.append(Finding(
+                check="DCG010", path=a.path, line=0, symbol=a.name,
+                key=f"f64:{a.name}:{prim}",
+                message=f"{a.name} computes in float64/complex128 "
+                        f"(first at {prim!r}) — an implicit promotion "
+                        "slipped in; TPUs emulate f64 at ~100x cost"))
+        for prim in a.transfers:
+            findings.append(Finding(
+                check="DCG010", path=a.path, line=0, symbol=a.name,
+                key=f"transfer:{a.name}:{prim}",
+                message=f"{a.name} embeds transfer primitive {prim!r} "
+                        "inside traced code — placement belongs to the "
+                        "caller (shardings/donation), not the program "
+                        "body"))
+    return findings
+
+
+def check_manifest(records: Sequence[manifest_lib.ProgramRecord],
+                   manifest_path: str) -> List[Finding]:
+    """DCG008 (drift half): live records vs the committed manifest."""
+    if not os.path.exists(manifest_path):
+        return [Finding(
+            check="DCG008", path="dcgan_tpu/analysis/programs.lock.jsonl",
+            line=0, symbol="<manifest>", key="manifest-missing",
+            message=f"no committed program manifest at {manifest_path} — "
+                    "generate one with `python -m dcgan_tpu.analysis "
+                    "--semantic --write-manifest`")]
+    return manifest_lib.diff(records, manifest_lib.load_path(manifest_path))
+
+
+def run_semantic(checks: Optional[Sequence[str]] = None,
+                 manifest_path: Optional[str] = None,
+                 compare_manifest: bool = True,
+                 ) -> Tuple[List[Finding],
+                            List[manifest_lib.ProgramRecord]]:
+    """The full semantic tier: enumerate + audit + every requested checker
+    (default: all four). Returns (findings, manifest records); the CLI
+    applies the shared baseline on top, exactly like the AST tier."""
+    if checks:
+        checks = [c.upper() for c in checks]
+        unknown = sorted(set(checks) - set(SEMANTIC_CHECKS))
+        if unknown:
+            raise ValueError(f"unknown semantic check ID(s) {unknown}; "
+                             f"valid: {list(SEMANTIC_CHECKS)}")
+    active = set(checks or SEMANTIC_CHECKS)
+    audits, coverage = enumerate_audits()
+    records = records_from(audits)
+    findings: List[Finding] = []
+    if "DCG007" in active:
+        findings += check_donation(audits)
+    if "DCG008" in active:
+        findings += check_transports()
+        if compare_manifest:
+            findings += check_manifest(
+                records,
+                manifest_path or manifest_lib.default_manifest_path())
+    if "DCG009" in active:
+        findings += check_warmup_coverage(coverage)
+        findings += check_retrace(audits)
+    if "DCG010" in active:
+        findings += check_hygiene(audits)
+    findings.sort(key=lambda f: (f.path, f.symbol, f.check, f.key))
+    return findings, records

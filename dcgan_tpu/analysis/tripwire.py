@@ -52,6 +52,14 @@ _installed = False
 _wrapped_count = 0
 _dispatch_thread: Optional[threading.Thread] = None
 
+#: coordination-module collective entry points install() wraps. Module
+#: constant (not an install()-local literal) because the semantic tier
+#: cross-checks coordination.TRANSPORT_CENSUS against it (DCG008): every
+#: declared transport must also be thread-policed here.
+WRAPPED_TRANSPORTS = ("_allgather_i32", "_allgather_f32",
+                      "fleet_health_gather", "anomaly_consensus",
+                      "warmup_barrier")
+
 
 def enabled() -> bool:
     """Whether the env knob asks for runtime thread checks."""
@@ -141,8 +149,7 @@ def install() -> int:
     from dcgan_tpu.utils import checkpoint
 
     count = 0
-    for name in ("_allgather_i32", "_allgather_f32", "fleet_health_gather",
-                 "anomaly_consensus", "warmup_barrier"):
+    for name in WRAPPED_TRANSPORTS:
         setattr(coordination, name,
                 _wrap_function(getattr(coordination, name),
                                f"coordination.{name}"))

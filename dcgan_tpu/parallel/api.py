@@ -27,6 +27,17 @@ from dcgan_tpu.train.steps import make_train_step
 
 Pytree = Any
 
+#: `programs`-dict names whose state argument (argnum 0) is donated — in
+#: BOTH backends, by construction. The semantic analyzer (DCG007) holds
+#: this in both directions against the compiled executables: every donated
+#: input of these programs must be realized as an `input_output_aliases`
+#: pair (donated-but-unaliased is a silent copy, and under the
+#: deserialized-executable guards of DESIGN §6d a latent heap hazard), and
+#: no program OUTSIDE this set may donate (an undeclared donor bypasses
+#: the trainer's donation-safety discipline). Adding a donating program
+#: means adding it here and regenerating analysis/programs.lock.jsonl.
+DONATED_PROGRAMS = ("train_step", "multi_step", "d_update", "g_update")
+
 
 @dataclasses.dataclass(frozen=True)
 class ParallelTrain:

@@ -26,6 +26,15 @@ Two reasons this backend exists beyond idiom parity:
 Scope: data parallelism only (mesh model axis must be 1 — tensor/spatial
 parallelism live in the GSPMD backend, where the partitioner earns its keep).
 
+Because every collective here is hand-written, this backend is the census
+surface of the semantic analyzer (DCG008, ISSUE 11): the per-program
+psum/all_gather counts in `analysis/programs.lock.jsonl` are counted from
+THESE programs' jaxprs (the GSPMD backend's collectives are
+partitioner-inserted and census 0 explicit). Changing the collective
+pattern — a new pmean, a gather moved — is a manifest change: regenerate
+with `python -m dcgan_tpu.analysis --semantic --write-manifest` and
+review the census diff, or tier-1 fails on unexplained drift.
+
 Per-shard randomness: the step key is folded with `lax.axis_index("data")`, so
 each shard draws an independent z sub-batch — the same global semantics as the
 GSPMD backend's single partitioned `jax.random.uniform`, though not the same

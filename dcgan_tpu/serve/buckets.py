@@ -21,6 +21,14 @@ serve-side variant that KEEPS the compiled executables (warmup can throw
 its copies away because the trainer's live dispatch goes through the jit
 wrappers; the server dispatches the AOT executables directly — no
 first-call deserialize, no jit-cache lookup on the latency path).
+
+The rungs are part of the committed program manifest (ISSUE 11): the
+semantic analyzer lowers `sampler_plan` over the default doubling ladder
+and records each rung's jaxpr fingerprint + donation map in
+`analysis/programs.lock.jsonl` (serve::sampler@b<N> rows — samplers must
+never donate; an accidental `donate_argnums` here is a DCG007 finding).
+Changing the ladder shape or the sampler program regenerates the
+manifest (`python -m dcgan_tpu.analysis --semantic --write-manifest`).
 """
 
 from __future__ import annotations

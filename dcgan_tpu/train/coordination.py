@@ -53,6 +53,30 @@ import numpy as np
 # drill) can attribute the exit to the watchdog specifically.
 WATCHDOG_EXIT_CODE = 43
 
+#: DCG008 census declarations for the host-side collective transports
+#: (ISSUE 11). `multihost_utils.process_allgather` is opaque to `.lower()`
+#: (its collective is inserted when jax reshards the host-local array), so
+#: unlike the jit programs these rows cannot be counted from a jaxpr — they
+#: are declared HERE, next to the transport code, and flow into the
+#: committed program manifest (analysis/programs.lock.jsonl) and DESIGN
+#: §6c.1's generated dispatch-stream table. The semantic tier cross-checks
+#: each entry's transport function still exists in this module, and the
+#: tripwire wraps the same names — three systems, one declaration.
+#: Rows: name -> (transport fn, {collective op: count}, default-knob cadence).
+TRANSPORT_CENSUS = {
+    "stop_consensus": ("_allgather_i32", {"all_gather": 1},
+                       "every step boundary (multi-host, `--coord_stop` "
+                       "default on; single-process: local flag, no "
+                       "collective)"),
+    "anomaly_consensus": ("_allgather_i32", {"all_gather": 1},
+                          "every `nan_check_steps`-th boundary "
+                          "(multi-host, BOTH nan policies; single-process: "
+                          "local verdict, no collective)"),
+    "fleet_health": ("_allgather_f32", {"all_gather": 1},
+                     "every `fleet_health_steps`-th boundary (default 0 = "
+                     "off; single-process: 1-row table, no collective)"),
+}
+
 
 def _allgather_i32(value: int) -> np.ndarray:
     """One int32 from every process, index-ordered. The single collective

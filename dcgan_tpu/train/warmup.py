@@ -218,6 +218,21 @@ def _identity_copy():
     return jax.jit(lambda t: jax.tree_util.tree_map(lambda a: a + 0, t))
 
 
+def state_example(pt):
+    """The train-state example argument for `.lower()`ing `pt`'s programs
+    WITHOUT allocating it: sharded ShapeDtypeStructs from `eval_shape` over
+    `pt.init` + `pt.shardings`. The warmup plan itself receives the live
+    state from the trainer; the semantic analyzer (ISSUE 11) lowers the
+    same plan pre-allocation, so the derivation lives here where the plan
+    is built and the two callers cannot shape-drift. The lambda matters:
+    under the armed tripwire pt.init is a _GuardedFn, which eval_shape
+    cannot weakref — a plain closure can."""
+    shapes = jax.eval_shape(lambda k: pt.init(k), jax.random.key(0))
+    return jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        shapes, pt.shardings)
+
+
 def _program_args(cfg, pt, state, *, sample_z=None, sample_labels=None,
                   eval_z=None) -> List[Tuple[str, Callable, tuple]]:
     """(name, jitted fn, example args) for every program `pt` can dispatch

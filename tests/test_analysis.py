@@ -723,3 +723,296 @@ class TestTripwire:
             sample_dir=str(tmp_path / "sm"))
         state = train(cfg, synthetic_data=True, max_steps=2)
         assert int(np.asarray(state["step"])) == 2
+
+
+# -- semantic tier (ISSUE 11) ------------------------------------------------
+# Fixtures are synthetic jitted programs audited through
+# semantic.audit_callable — the spec for what the lowered-program checkers
+# resolve, each with a clean twin. The real enumeration runs as the
+# tier-1 subprocess pin (tests/test_tools.py), not in-process.
+
+import dataclasses as _dc
+import os as _os
+
+import jax as _jax
+import jax.numpy as _jnp
+
+from dcgan_tpu.analysis import manifest as mlib
+from dcgan_tpu.analysis import semantic
+
+
+def _audit(fn, args, name="fx::prog", expect_donation=False):
+    return semantic.audit_callable(name, fn, args, path="dcgan_tpu/fx.py",
+                                   expect_donation=expect_donation)
+
+
+class TestDonationAliasing:
+    """DCG007: donation realized as aliasing, both directions."""
+
+    def test_donated_but_unaliased_flagged(self):
+        # the donated dict arg is USED (so it is a live executable input)
+        # but no output matches its shape — XLA cannot alias it and every
+        # dispatch silently copies
+        fn = _jax.jit(lambda s, x: s["a"].sum() + x,
+                      donate_argnums=(0,))
+        a = _audit(fn, ({"a": _jnp.zeros((4,))}, _jnp.zeros(())),
+                   expect_donation=True)
+        assert a.donation is not None
+        assert a.donation["donated"] == 1 and a.donation["aliased"] == 0
+        fs = semantic.check_donation([a])
+        assert [f.check for f in fs] == ["DCG007"]
+        assert fs[0].key.startswith("unaliased:fx::prog:")
+        assert "'a'" in fs[0].key
+        assert "input_output_aliases" in fs[0].message
+
+    def test_realized_donation_clean(self):
+        fn = _jax.jit(lambda s, x: ({"a": s["a"] + x}, x.sum()),
+                      donate_argnums=(0,))
+        a = _audit(fn, ({"a": _jnp.zeros((4,))}, _jnp.ones((4,))),
+                   expect_donation=True)
+        assert a.donation == {"donated": 1, "aliased": 1, "pruned": 0,
+                              "unaliased": []}
+        assert semantic.check_donation([a]) == []
+
+    def test_pruned_donation_is_not_a_copy_hazard(self):
+        # an UNUSED donated arg is pruned from the executable entirely —
+        # no input buffer, no copy; classified, not flagged
+        fn = _jax.jit(lambda s, x: x * 2.0, donate_argnums=(0,))
+        a = _audit(fn, ({"a": _jnp.zeros((4,))}, _jnp.ones((4,))),
+                   expect_donation=True)
+        assert a.donation["pruned"] == 1 and a.donation["unaliased"] == []
+        assert semantic.check_donation([a]) == []
+
+    def test_declared_donor_that_stopped_donating_flagged(self):
+        fn = _jax.jit(lambda s: {"a": s["a"] * 2})
+        a = _audit(fn, ({"a": _jnp.zeros((4,))},), expect_donation=True)
+        assert a.donation is None
+        fs = semantic.check_donation([a])
+        assert [f.key for f in fs] == ["undonated:fx::prog"]
+
+    def test_undeclared_donor_flagged(self):
+        fn = _jax.jit(lambda s: {"a": s["a"] * 2}, donate_argnums=(0,))
+        a = _audit(fn, ({"a": _jnp.zeros((4,))},), expect_donation=False)
+        fs = semantic.check_donation([a])
+        assert [f.key for f in fs] == ["undeclared-donor:fx::prog"]
+
+    def test_non_donor_clean(self):
+        a = _audit(_jax.jit(lambda x: x + 1), (_jnp.ones((2,)),))
+        assert a.donation is None
+        assert semantic.check_donation([a]) == []
+
+
+class TestProgramManifest:
+    """DCG008: manifest round-trip, deliberate-drift detection, the
+    transport registry, and the generated DESIGN §6c.1 table."""
+
+    REC = mlib.ProgramRecord(
+        name="fx::prog", kind="program", path="dcgan_tpu/fx.py",
+        args=("f32[2]",), fingerprint="abcd1234abcd1234",
+        collectives={"psum": 2}, donation={"donated": 1, "aliased": 1,
+                                           "pruned": 0, "unaliased": []},
+        cadence="every step")
+
+    def test_write_read_round_trip(self, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        with open(path, "w") as f:
+            f.write(mlib.dumps([self.REC]))
+        assert mlib.load_path(path) == [self.REC]
+        # serialization is deterministic: a second dump is byte-identical
+        assert mlib.dumps([self.REC]) == mlib.dumps([self.REC])
+
+    def test_census_drift_detected(self):
+        committed = [_dc.replace(self.REC, collectives={"psum": 3})]
+        fs = mlib.diff([self.REC], committed)
+        assert [f.check for f in fs] == ["DCG008"]
+        assert fs[0].key == "census:fx::prog"
+        assert "psum ×2" in fs[0].message and "psum ×3" in fs[0].message
+
+    def test_fingerprint_and_donation_drift_detected(self):
+        committed = [_dc.replace(
+            self.REC, fingerprint="ffff0000ffff0000",
+            donation={"donated": 1, "aliased": 0, "pruned": 0,
+                      "unaliased": ["[0]"]})]
+        keys = {f.key for f in mlib.diff([self.REC], committed)}
+        assert keys == {"fingerprint:fx::prog", "donation:fx::prog"}
+
+    def test_vanished_and_uncommitted_programs_detected(self):
+        other = _dc.replace(self.REC, name="fx::other")
+        assert {f.key for f in mlib.diff([self.REC], [other])} == \
+            {"missing:fx::other", "uncommitted:fx::prog"}
+
+    def test_identical_records_clean(self):
+        assert mlib.diff([self.REC], [_dc.replace(self.REC)]) == []
+
+    def test_missing_manifest_is_a_finding(self, tmp_path):
+        fs = semantic.check_manifest([self.REC],
+                                     str(tmp_path / "nope.jsonl"))
+        assert [f.key for f in fs] == ["manifest-missing"]
+
+    def test_transport_registry_live_and_wrapped(self, monkeypatch):
+        assert semantic.check_transports() == []
+        from dcgan_tpu.train import coordination
+
+        monkeypatch.setattr(
+            coordination, "TRANSPORT_CENSUS",
+            {"ghost": ("_allgather_i64", {"all_gather": 1}, "never")})
+        keys = {f.key for f in semantic.check_transports()}
+        assert keys == {"transport:ghost", "transport-unwrapped:ghost"}
+
+    def test_committed_manifest_carries_the_consensus_transports(self):
+        recs = mlib.load_path(mlib.default_manifest_path())
+        transports = {r.name for r in recs if r.kind == "transport"}
+        # the two PR 4 consensus allgathers, by name — the §6c.1 stream
+        assert {"coordination::stop_consensus",
+                "coordination::anomaly_consensus"} <= transports
+        # and the dispatch surface itself: both backends + serve rungs
+        names = {r.name for r in recs}
+        assert "gspmd::train_step" in names
+        assert "shard_map::train_step" in names
+        assert any(n.startswith("serve::sampler@b") for n in names)
+
+    def test_design_stream_table_matches_committed_manifest(self):
+        """The §6c.1 dispatch-stream table is GENERATED — the doc block
+        between the markers must equal the render from the committed
+        manifest, so the doc cannot drift from the programs."""
+        recs = mlib.load_path(mlib.default_manifest_path())
+        design_path = _os.path.join(core.default_root(), "docs",
+                                    "DESIGN.md")
+        with open(design_path, encoding="utf-8") as f:
+            design = f.read()
+        i = design.find(mlib.STREAM_TABLE_BEGIN)
+        j = design.find(mlib.STREAM_TABLE_END)
+        assert 0 <= i < j, "stream-table markers missing from DESIGN §6c.1"
+        block = design[i + len(mlib.STREAM_TABLE_BEGIN):j].strip()
+        assert block == mlib.render_stream_table(recs), (
+            "DESIGN §6c.1 stream table drifted from the committed "
+            "manifest — regenerate with `python -m dcgan_tpu.analysis "
+            "--semantic --stream-table` and paste between the markers")
+
+
+class TestRetraceHazards:
+    """DCG009: baked-in consts, weak-typed leaks, warmup coverage."""
+
+    def test_closure_captured_array_flagged(self):
+        big = _jnp.arange(100.0)
+        a = _audit(_jax.jit(lambda x: x + big.sum()), (_jnp.zeros(()),))
+        fs = semantic.check_retrace([a])
+        assert len(fs) == 1 and fs[0].check == "DCG009"
+        assert fs[0].key.startswith("const:fx::prog:")
+        assert "100 elements" in fs[0].message
+
+    def test_argument_passed_array_clean(self):
+        a = _audit(_jax.jit(lambda x, big: x + big.sum()),
+                   (_jnp.zeros(()), _jnp.arange(100.0)))
+        assert semantic.check_retrace([a]) == []
+
+    def test_weak_typed_const_flagged(self):
+        w = _jnp.asarray(3.0)  # python float -> weak-typed scalar
+        assert w.aval.weak_type
+        a = _audit(_jax.jit(lambda x: x * w), (_jnp.ones((2,)),))
+        fs = semantic.check_retrace([a])
+        assert [f.check for f in fs] == ["DCG009"]
+        assert fs[0].key.startswith("weak-const:")
+
+    def test_strong_typed_const_clean(self):
+        w = _jnp.float32(3.0)
+        a = _audit(_jax.jit(lambda x: x * w), (_jnp.ones((2,)),))
+        assert semantic.check_retrace([a]) == []
+
+    def test_warmup_coverage_gap_flagged(self):
+        row = semantic.CoverageRow(
+            variant="fx", path="dcgan_tpu/fx.py",
+            programs=frozenset({"train_step", "sampler"}),
+            plan=("train_step",),
+            must_cover=frozenset({"train_step", "sampler"}))
+        keys = {f.key for f in semantic.check_warmup_coverage([row])}
+        assert keys == {"warmup-gap:fx:sampler",
+                        "warmup-unplanned:fx:sampler"}
+
+    def test_warmup_full_coverage_clean(self):
+        row = semantic.CoverageRow(
+            variant="fx", path="dcgan_tpu/fx.py",
+            programs=frozenset({"train_step", "sampler", "init"}),
+            plan=("train_step", "sampler"),
+            must_cover=frozenset({"train_step", "sampler"}))
+        assert semantic.check_warmup_coverage([row]) == []
+
+    def test_shape_variant_covers_base_program(self):
+        # multi_step planned as "multi_step@k2" still covers the
+        # programs-dict entry "multi_step" (base-name match)
+        row = semantic.CoverageRow(
+            variant="fx", path="dcgan_tpu/fx.py",
+            programs=frozenset({"multi_step"}),
+            plan=("multi_step@k2",),
+            must_cover=frozenset({"multi_step@k2"}))
+        assert semantic.check_warmup_coverage([row]) == []
+
+
+class TestTracedBodySemanticHygiene:
+    """DCG010: callbacks, f64 promotion, embedded transfers."""
+
+    def test_host_callback_flagged(self):
+        def body(x):
+            _jax.debug.print("x = {}", x)
+            return x + 1
+
+        a = _audit(_jax.jit(body), (_jnp.ones((2,)),))
+        fs = semantic.check_hygiene([a])
+        assert len(fs) == 1 and fs[0].check == "DCG010"
+        assert fs[0].key.startswith("callback:")
+
+    def test_embedded_device_put_flagged(self):
+        a = _audit(_jax.jit(lambda x: _jax.device_put(x) * 2),
+                   (_jnp.ones((2,)),))
+        fs = semantic.check_hygiene([a])
+        assert [f.key for f in fs] == \
+            ["transfer:fx::prog:device_put"]
+
+    def test_f64_promotion_flagged(self):
+        from jax.experimental import enable_x64
+
+        with enable_x64():
+            a = _audit(_jax.jit(lambda x: x.astype(_jnp.float64) * 2),
+                       (_jnp.ones((2,), _jnp.float32),))
+        fs = semantic.check_hygiene([a])
+        assert fs and all(f.key.startswith("f64:") for f in fs)
+
+    def test_plain_program_clean(self):
+        a = _audit(_jax.jit(lambda x: x * 2 + 1), (_jnp.ones((2,)),))
+        assert semantic.check_hygiene([a]) == []
+
+
+class TestSemanticBaselineAndChecks:
+    """The shared suppression machinery extended to DCG007-010."""
+
+    def test_semantic_finding_round_trips_through_baseline(self):
+        fn = _jax.jit(lambda s, x: s["a"].sum() + x, donate_argnums=(0,))
+        a = _audit(fn, ({"a": _jnp.zeros((4,))}, _jnp.zeros(())),
+                   expect_donation=True)
+        fs = semantic.check_donation([a])
+        assert len(fs) == 1
+        entry = fs[0].baseline_entry(why="fixture: reviewed copy is fine")
+        new, old = core.split_baselined(fs, [entry])
+        assert new == [] and len(old) == 1
+        # multiset semantics: a SECOND identical finding still fails
+        new2, old2 = core.split_baselined(fs + fs, [entry])
+        assert len(new2) == 1 and len(old2) == 1
+
+    def test_semantic_ids_rejected_by_ast_driver_with_redirect(self):
+        with pytest.raises(ValueError, match="--semantic"):
+            run({"dcgan_tpu/x.py": "x = 1\n"}, checks=["DCG007"])
+
+    def test_unknown_semantic_id_rejected(self):
+        with pytest.raises(ValueError, match="DCG999"):
+            semantic.run_semantic(checks=["DCG999"])
+
+    def test_records_from_audits_match_manifest_shape(self):
+        a = _audit(_jax.jit(lambda x: x + 1), (_jnp.ones((2,)),))
+        recs = semantic.records_from([a])
+        by_name = {r.name: r for r in recs}
+        assert by_name["fx::prog"].kind == "program"
+        assert by_name["fx::prog"].fingerprint == a.fingerprint
+        # the declared transports always join the record set
+        assert "coordination::stop_consensus" in by_name
+        text = mlib.dumps(recs)
+        assert mlib.loads(text) == sorted(recs, key=lambda r: r.name)

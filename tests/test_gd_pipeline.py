@@ -233,6 +233,39 @@ class TestWarmupPlanStages:
         assert "gen_fakes@lr_backoff" not in names
 
 
+class TestShardMapStagesTrace:
+    """Regression for the `lax.pcast` latent crash (ISSUE 11 triage):
+    this container's jax 0.4.37 predates the VMA type system, so
+    steps.py::_zero_metric must fall back to the plain replicated zero
+    instead of crashing every shard_map stage-program trace — the tier-1
+    suite never lowered these programs on this backend, and the semantic
+    analyzer's first enumeration could not even complete."""
+
+    def test_shard_map_pipeline_stages_trace(self):
+        import jax.numpy as jnp
+
+        from dcgan_tpu.parallel import make_mesh, make_parallel_train
+        from dcgan_tpu.train import warmup
+
+        cfg = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8,
+                                            df_dim=8,
+                                            compute_dtype="float32"),
+                          batch_size=8, backend="shard_map",
+                          pipeline_gd=True)
+        pt = make_parallel_train(cfg, make_mesh(cfg.mesh))
+        state = warmup.state_example(pt)
+        img = jax.ShapeDtypeStruct(
+            (8, 16, 16, cfg.model.c_dim), jnp.float32)
+        fakes = jax.ShapeDtypeStruct(
+            (cfg.n_critic, 8, 16, 16, cfg.model.c_dim), jnp.float32)
+        key = jax.random.key(0)
+        # tracing is the regression surface: pcast raised AttributeError
+        # inside the d_update critic scan before any compile
+        d = pt.d_update.trace(state, img, fakes, key)
+        g = pt.g_update.trace(state, key)
+        assert d.jaxpr is not None and g.jaxpr is not None
+
+
 @pytest.mark.slow
 class TestTrainerPipelineContracts:
     """Trainer-level contracts on the real loop (CPU): fused parity,
