@@ -9,6 +9,7 @@ small preset, and checks the contracts that only exist after tracing:
     DCG008  collective census vs the manifest check_manifest/check_transports
     DCG009  retrace hazards + warmup coverage check_warmup_coverage/check_retrace
     DCG010  traced-body hygiene               check_hygiene
+    DCG011  sharding-rule spec coverage       check_spec_coverage
 
 The enumeration is the repo's real dispatch surface: both ParallelTrain
 backends' `programs` dicts through the AOT warmup plan (train/warmup.py —
@@ -41,7 +42,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from dcgan_tpu.analysis import manifest as manifest_lib
 from dcgan_tpu.analysis.core import Finding
 
-SEMANTIC_CHECKS = ("DCG007", "DCG008", "DCG009", "DCG010")
+SEMANTIC_CHECKS = ("DCG007", "DCG008", "DCG009", "DCG010", "DCG011")
 
 #: devices the canonical topology forces / the enumeration's mesh uses
 CANONICAL_DEVICES = 2
@@ -85,6 +86,7 @@ GROUP_PATHS = {
     "shard_map": "dcgan_tpu/parallel/shard_map_backend.py",
     "serve": "dcgan_tpu/serve/buckets.py",
     "coordination": "dcgan_tpu/train/coordination.py",
+    "elastic": "dcgan_tpu/elastic/rules.py",
 }
 
 
@@ -616,6 +618,83 @@ def check_hygiene(audits: Sequence[ProgramAudit]) -> List[Finding]:
     return findings
 
 
+#: DCG011: the model-family variants whose FULL train state (params, both
+#: optimizer states, BN/SN state, EMA, step) must be rule-covered — the
+#: structural union of what the repo can train: plain dcgan, dcgan with
+#: attention + spectral norm + conditioning, the resnet family with
+#: attention + SN, and stylegan with SN (its norm-free critic is the
+#: resnet one). eval_shape only — no arrays, no lowering.
+def spec_coverage_variants():
+    from dcgan_tpu.config import ModelConfig, TrainConfig
+
+    return (
+        ("dcgan", TrainConfig(model=ModelConfig(
+            output_size=16, gf_dim=8, df_dim=8,
+            compute_dtype="float32"), batch_size=8)),
+        ("dcgan+attn+sn+cond", TrainConfig(model=ModelConfig(
+            output_size=32, gf_dim=8, df_dim=8, compute_dtype="float32",
+            attn_res=16, spectral_norm="gd", num_classes=10),
+            batch_size=8)),
+        ("resnet+attn+sn", TrainConfig(model=ModelConfig(
+            arch="resnet", output_size=32, gf_dim=8, df_dim=8,
+            compute_dtype="float32", attn_res=16, spectral_norm="d"),
+            batch_size=8, loss="hinge")),
+        ("stylegan+sn", TrainConfig(model=ModelConfig(
+            arch="stylegan", output_size=32, gf_dim=8, df_dim=8,
+            compute_dtype="float32", spectral_norm="d"),
+            batch_size=8, loss="hinge")),
+    )
+
+
+def check_spec_coverage() -> List[Finding]:
+    """DCG011: every leaf of every model family's train state must match
+    EXACTLY ONE row of the sharding-rule table (elastic/rules.py). An
+    unmatched leaf means a new layer has no classified placement (the
+    engine raises at run time — this catches it at lint time, for every
+    family at once); a multiply-matched leaf means two rows compete and
+    first-match order silently decides a spec — the checkpoint sidecar
+    and the cross-topology restore both resolve through this table, so
+    ambiguity here is placement nondeterminism there."""
+    import jax
+
+    from dcgan_tpu.elastic import rules
+    from dcgan_tpu.train.steps import init_train_state
+
+    findings: List[Finding] = []
+    path = GROUP_PATHS["elastic"]
+    for variant, cfg in spec_coverage_variants():
+        shapes = jax.eval_shape(lambda k, c=cfg: init_train_state(k, c),
+                                jax.random.key(0))
+        for leaf_path, leaf in jax.tree_util.tree_flatten_with_path(
+                shapes)[0]:
+            p = rules.path_str(leaf_path)
+            ndim = len(getattr(leaf, "shape", ()))
+            hits = rules.matching_rules(p, ndim)
+            if len(hits) == 1:
+                continue
+            if not hits:
+                findings.append(Finding(
+                    check="DCG011", path=path, line=0,
+                    symbol=f"{variant}::state",
+                    key=f"spec-unmatched:{variant}:{p}",
+                    message=f"[{variant}] state leaf {p!r} (rank {ndim}) "
+                            "matches NO row of PARTITION_RULES — an "
+                            "unclassified placement; the engine would "
+                            "raise at the first state_shardings call at "
+                            "this config"))
+            else:
+                pats = [rules.PARTITION_RULES[i][0] for i in hits]
+                findings.append(Finding(
+                    check="DCG011", path=path, line=0,
+                    symbol=f"{variant}::state",
+                    key=f"spec-ambiguous:{variant}:{p}",
+                    message=f"[{variant}] state leaf {p!r} (rank {ndim}) "
+                            f"matches {len(hits)} rules ({pats}) — "
+                            "first-match order is silently deciding its "
+                            "spec; make the patterns disjoint"))
+    return findings
+
+
 def check_manifest(records: Sequence[manifest_lib.ProgramRecord],
                    manifest_path: str) -> List[Finding]:
     """DCG008 (drift half): live records vs the committed manifest."""
@@ -635,7 +714,7 @@ def run_semantic(checks: Optional[Sequence[str]] = None,
                  ) -> Tuple[List[Finding],
                             List[manifest_lib.ProgramRecord]]:
     """The full semantic tier: enumerate + audit + every requested checker
-    (default: all four). Returns (findings, manifest records); the CLI
+    (default: all five). Returns (findings, manifest records); the CLI
     applies the shared baseline on top, exactly like the AST tier."""
     if checks:
         checks = [c.upper() for c in checks]
@@ -644,8 +723,16 @@ def run_semantic(checks: Optional[Sequence[str]] = None,
             raise ValueError(f"unknown semantic check ID(s) {unknown}; "
                              f"valid: {list(SEMANTIC_CHECKS)}")
     active = set(checks or SEMANTIC_CHECKS)
-    audits, coverage = enumerate_audits()
-    records = records_from(audits)
+    # DCG011 is eval_shape-only — a `--checks DCG011` run (the command the
+    # rule engine's unmatched-leaf error names) must not pay the full
+    # trace+lower enumeration it never reads. Manifest regeneration
+    # (compare_manifest=False is the CLI's --write-manifest mode) always
+    # enumerates: the records ARE its output.
+    if active - {"DCG011"} or not compare_manifest:
+        audits, coverage = enumerate_audits()
+        records = records_from(audits)
+    else:
+        audits, coverage, records = [], [], []
     findings: List[Finding] = []
     if "DCG007" in active:
         findings += check_donation(audits)
@@ -660,5 +747,7 @@ def run_semantic(checks: Optional[Sequence[str]] = None,
         findings += check_retrace(audits)
     if "DCG010" in active:
         findings += check_hygiene(audits)
+    if "DCG011" in active:
+        findings += check_spec_coverage()
     findings.sort(key=lambda f: (f.path, f.symbol, f.check, f.key))
     return findings, records

@@ -324,6 +324,18 @@ class TrainConfig:
                                    # loop machinery can be measured at chip
                                    # rate over transports that cannot sustain
                                    # the feed (tools/bench_trainer_loop.py)
+    synthetic_global_stream: bool = False  # with synthetic data: every
+                                   # process generates the FULL global batch
+                                   # from one seed and cuts its own block, so
+                                   # the global batch sequence is IDENTICAL
+                                   # for every process layout over the same
+                                   # mesh (2 proc x 1 dev == 1 proc x 2 dev,
+                                   # bit-for-bit). The layout-invariance the
+                                   # elastic shrink/grow drills replay losses
+                                   # across (tools/chaos_drill.py); default
+                                   # off — the block-seeded stream pays 1/P
+                                   # of the host cost and stays byte-exact
+                                   # with prior builds
 
     # Observability (image_train.py:37,129,179)
     async_services: bool = True    # run host-side observability (deferred

@@ -1,0 +1,24 @@
+"""Elastic topology (ISSUE 12): sharding specs as data, restore across
+meshes.
+
+- `rules`: the regex sharding-rule engine — one table mapping pytree
+  paths to logical PartitionSpecs for params, optimizer state, and EMA
+  across all three model families (`parallel/sharding.py` keeps its
+  public names as thin wrappers over it).
+- `sidecar`: the per-checkpoint sharding sidecar — logical specs + mesh
+  axis names/sizes + process count, written next to the integrity
+  manifests so a checkpoint carries its topology instead of assuming it.
+- `reshard`: topology-change-aware restore — host-side staging when the
+  process count changed, a NamedSharding-directed device read otherwise.
+"""
+
+from dcgan_tpu.elastic.rules import (  # noqa: F401
+    PARTITION_RULES,
+    REPLICATED,
+    logical_spec,
+    matching_rules,
+    path_str,
+    resolve_spec,
+    state_partition_specs,
+    state_shardings,
+)

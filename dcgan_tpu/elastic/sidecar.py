@@ -1,0 +1,141 @@
+"""The checkpoint sharding sidecar: a step's topology as data.
+
+Every checkpoint step gets `integrity/<step>.sharding.json` beside its
+integrity manifest: the saving mesh's axis names and sizes, the saving
+process count, and the resolved per-leaf partition specs — everything
+`restore_latest` needs to DETECT a topology change before any payload
+byte moves, and everything an operator needs to answer "what was this
+checkpoint sharded like?" without booting the saving fleet.
+
+The sidecar is derived from the live state tree at save time (each leaf's
+NamedSharding carries the global mesh and spec on every process), so all
+savers — the trainer's periodic/final saves, best-checkpoint retention,
+tools — get one for free. Absence is never an error: legacy steps and
+states without NamedShardings (host-tree tests) restore exactly as
+before, same-topology.
+
+Schema (version 1):
+
+    {"version": 1,
+     "process_count": 2,
+     "mesh": {"axes": ["data", "model"], "sizes": [32, 1]},
+     "specs": {"params/gen/proj/w": [null, "model"], ...}}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+from dcgan_tpu.elastic.rules import path_str
+
+Pytree = Any
+
+VERSION = 1
+
+#: sidecars live beside the integrity manifests (utils/checkpoint.py owns
+#: the directory constant; re-declared here to keep this module jax-free
+#: at import)
+INTEGRITY_DIRNAME = "integrity"
+
+
+def sidecar_path(directory: str, step: int) -> str:
+    return os.path.join(directory, INTEGRITY_DIRNAME,
+                        f"{int(step)}.sharding.json")
+
+
+def _mesh_of(state: Pytree):
+    """The (global) Mesh of the first NamedSharding leaf, or None for
+    host/np trees — which simply don't get a sidecar."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(state):
+        sh = getattr(leaf, "sharding", None)
+        if sh is not None and getattr(sh, "mesh", None) is not None \
+                and hasattr(sh, "spec"):
+            return sh.mesh
+    return None
+
+
+def build_payload(state: Pytree) -> Optional[Dict[str, Any]]:
+    """The sidecar dict for a live sharded state tree, or None when the
+    tree carries no NamedShardings (nothing to record)."""
+    import jax
+
+    mesh = _mesh_of(state)
+    if mesh is None:
+        return None
+    specs: Dict[str, list] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state)[0]:
+        sh = getattr(leaf, "sharding", None)
+        spec = getattr(sh, "spec", None)
+        ndim = len(getattr(leaf, "shape", ()))
+        parts = [None] * ndim
+        if spec is not None:
+            for d, axis in enumerate(tuple(spec)[:ndim]):
+                # a PartitionSpec entry may be a tuple of axis names;
+                # record it verbatim (json-serializable either way)
+                parts[d] = list(axis) if isinstance(axis, tuple) else axis
+        specs[path_str(path)] = parts
+    return {
+        "version": VERSION,
+        "process_count": int(jax.process_count()),
+        "mesh": {"axes": [str(a) for a in mesh.axis_names],
+                 "sizes": [int(mesh.shape[a]) for a in mesh.axis_names]},
+        "specs": specs,
+    }
+
+
+def read(directory: str, step: int) -> Optional[Dict[str, Any]]:
+    """The step's sidecar payload, or None when absent/unreadable — an
+    unreadable sidecar degrades to the pre-elastic behavior (assume the
+    saving topology), it never condemns a step."""
+    path = sidecar_path(directory, step)
+    try:
+        with open(path, encoding="utf-8") as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(payload, dict) or "mesh" not in payload:
+        return None
+    return payload
+
+
+def current_topology(state: Pytree) -> Optional[Tuple[Tuple[str, ...],
+                                                      Tuple[int, ...], int]]:
+    """(axis names, axis sizes, process count) of a sharded tree, or None
+    for host trees."""
+    import jax
+
+    mesh = _mesh_of(state)
+    if mesh is None:
+        return None
+    return (tuple(str(a) for a in mesh.axis_names),
+            tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+            int(jax.process_count()))
+
+
+def topology_mismatch(payload: Dict[str, Any],
+                      state: Pytree) -> Optional[str]:
+    """Why the saved topology differs from the target tree's, or None
+    when they match (or when either side is unknowable — no sharded
+    leaves, malformed payload — in which case the same-topology path is
+    the only safe answer)."""
+    cur = current_topology(state)
+    if cur is None:
+        return None
+    try:
+        saved_axes = tuple(str(a) for a in payload["mesh"]["axes"])
+        saved_sizes = tuple(int(s) for s in payload["mesh"]["sizes"])
+        saved_procs = int(payload.get("process_count", 1))
+    except (KeyError, TypeError, ValueError):
+        return None
+    axes, sizes, procs = cur
+    diffs = []
+    if saved_axes != axes or saved_sizes != sizes:
+        diffs.append(f"mesh {dict(zip(saved_axes, saved_sizes))} -> "
+                     f"{dict(zip(axes, sizes))}")
+    if saved_procs != procs:
+        diffs.append(f"processes {saved_procs} -> {procs}")
+    return "; ".join(diffs) if diffs else None

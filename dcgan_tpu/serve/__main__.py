@@ -168,6 +168,14 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"{cold.get('warmup_ms', 0.0):.0f} ms{cache_note}) — "
           f"{meta.get('source')} step {meta.get('step')} "
           f"{meta.get('weights')} weights", flush=True)
+    if meta.get("resharded"):
+        # elastic cold start (ISSUE 12): the checkpoint was saved on a
+        # different topology and restored through the sidecar reshard
+        rs = meta["resharded"]
+        print(f"[dcgan_tpu.serve] cross-topology cold start: checkpoint "
+              f"saved on {rs['saved_processes']} process(es) x "
+              f"{rs['saved_devices']} device(s), resharded onto this "
+              f"host's mesh in {rs['reshard_ms']:.0f} ms", flush=True)
     print("[dcgan_tpu.serve] warm: serving", flush=True)
 
     arrivals = _load_arrivals(args)

@@ -73,16 +73,31 @@ class CheckpointSource:
                           g_ema_decay=0.999 if self.use_ema else 0.0)
         self._pt = make_parallel_train(cfg, mesh)
         state = self._pt.init(jax.random.key(0))
-        restored = Checkpointer(self.checkpoint_dir).restore_latest(state)
+        ckpt = Checkpointer(self.checkpoint_dir)
+        restored = ckpt.restore_latest(state)
         if restored is None:
             raise FileNotFoundError(
                 f"no checkpoint under {self.checkpoint_dir}")
         self._state = restored
         self.z_dim = mcfg.z_dim
         self.num_classes = mcfg.num_classes
-        return {"source": "checkpoint",
+        # elastic cold start (ISSUE 12): a checkpoint saved on a different
+        # topology restores through the sharding sidecar's reshard path —
+        # the serving mesh is whatever THIS host has, not whatever the
+        # training fleet had. Surfaced in the metadata (and the server's
+        # warm banner) so an operator can see a cross-topology cold start
+        # happened and what it cost.
+        meta = {"source": "checkpoint",
                 "step": int(jax.device_get(restored["step"])),
                 "weights": "ema" if self.use_ema else "live"}
+        if ckpt.last_reshard is not None:
+            meta["resharded"] = {
+                "saved_processes": int(
+                    ckpt.last_reshard["saved_processes"]),
+                "saved_devices": int(ckpt.last_reshard["saved_devices"]),
+                "reshard_ms": round(ckpt.last_reshard["reshard_ms"], 1),
+            }
+        return meta
 
     def bucket_plan(self, ladder: BucketLadder):
         return sampler_plan(self._pt.sample, ladder, self.z_dim,

@@ -196,6 +196,17 @@ def maybe_hang(step: int) -> None:
 
 # -- disk-fault helpers (drill/tests only; never called by production) -------
 
+def clone_checkpoint_dir(src: str, dst: str) -> str:
+    """Copy a finished checkpoint directory (steps + integrity manifests +
+    sharding sidecars + config.json) so independent resume arms can each
+    append their own events/checkpoints without contaminating the other —
+    the elastic shrink/grow drills resume ONE saved state on TWO
+    topologies and diff the replays (tools/chaos_drill.py). Returns dst."""
+    import shutil
+
+    shutil.copytree(src, dst)
+    return dst
+
 def corrupt_tfrecord_payload(path: str, record_index: int = 0) -> int:
     """Flip one byte inside record `record_index`'s payload, leaving its CRC
     untouched — a CRC-verifying reader sees a data-CRC mismatch at exactly

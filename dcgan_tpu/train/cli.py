@@ -135,6 +135,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="with --synthetic: pre-stage N batches on device "
                         "and cycle them (loop-speed measurement; see "
                         "tools/bench_trainer_loop.py)")
+    p.add_argument("--synthetic_global_stream", type=_parse_bool,
+                   default=False, metavar="{true,false}",
+                   help="with --synthetic: generate the full global batch "
+                        "on every process and cut the local block, so the "
+                        "batch sequence is identical across process "
+                        "layouts of the same mesh (the elastic shrink/"
+                        "grow drills' loss-replay invariance; costs P x "
+                        "the host generation)")
     # observability / checkpoint (image_train.py:20-21,37,129)
     p.add_argument("--async_services", type=_parse_bool, default=True,
                    metavar="{true,false}",
@@ -329,6 +337,7 @@ _FLAG_FIELDS = {
     "label_feature": ("", "label_feature"),
     "prefetch_device_batches": ("", "prefetch_device_batches"),
     "synthetic_device_cache": ("", "synthetic_device_cache"),
+    "synthetic_global_stream": ("", "synthetic_global_stream"),
     "async_services": ("", "async_services"),
     "checkpoint_dir": ("", "checkpoint_dir"), "sample_dir": ("", "sample_dir"),
     "save_summaries_secs": ("", "save_summaries_secs"),

@@ -63,6 +63,17 @@ EVENT_KEYS: Dict[str, str] = {
     "anomaly/rollbacks": "nan_policy=rollback",
     "data/corrupt_records": "nonzero quarantine count",
 
+    # -- elastic topology (ISSUE 12): a restore that RESHARDED because the
+    #    checkpoint's sharding sidecar names a different topology. Gated by
+    #    the reshard event itself, never by a knob — same-topology streams
+    #    (sidecar present, reshard path not taken) stay byte-identical ----
+    "elastic/resharded": "cross-topology restore",
+    "elastic/saved_processes": "cross-topology restore",
+    "elastic/saved_devices": "cross-topology restore",
+    "elastic/host_stage": "cross-topology restore",
+    "perf/restore/reshard_ms": "cross-topology restore",
+    "perf/restore/reshard_leaves": "cross-topology restore",
+
     # -- fleet health plane (ISSUE 6, coordination.fleet_metrics) --------
     "fleet/step_ms_max": "fleet_health_steps",
     "fleet/step_ms_min": "fleet_health_steps",

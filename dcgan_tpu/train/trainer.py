@@ -124,16 +124,34 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
         box = process_local_box(
             sharding, (cfg.batch_size, size, size, cfg.model.c_dim))
         n_local = box[0].stop - box[0].start
-        src = synthetic_batches(
-            n_local, size, cfg.model.c_dim,
-            seed=cfg.seed + seed_offset + box[0].start,
-            num_classes=cfg.model.num_classes)
-        hwc = (box[1], box[2], box[3])
+        if cfg.synthetic_global_stream:
+            # layout-invariant stream (ISSUE 12): every process draws the
+            # FULL global batch from the offset-0 seed and cuts its own
+            # block, so the global batch sequence is bit-identical for
+            # every process layout over the same mesh — the property the
+            # elastic shrink/grow drills replay losses across. Costs P x
+            # the host generation; single-process (full box) it IS the
+            # default stream, byte for byte.
+            src = synthetic_batches(
+                cfg.batch_size, size, cfg.model.c_dim,
+                seed=cfg.seed + seed_offset,
+                num_classes=cfg.model.num_classes)
 
-        def cut(batch):
-            if isinstance(batch, tuple):
-                return batch[0][(slice(None),) + hwc], batch[1]
-            return batch[(slice(None),) + hwc]
+            def cut(batch):
+                if isinstance(batch, tuple):
+                    return batch[0][tuple(box)], batch[1][box[0]]
+                return batch[tuple(box)]
+        else:
+            src = synthetic_batches(
+                n_local, size, cfg.model.c_dim,
+                seed=cfg.seed + seed_offset + box[0].start,
+                num_classes=cfg.model.num_classes)
+            hwc = (box[1], box[2], box[3])
+
+            def cut(batch):
+                if isinstance(batch, tuple):
+                    return batch[0][(slice(None),) + hwc], batch[1]
+                return batch[(slice(None),) + hwc]
 
         if cfg.synthetic_device_cache > 0:
             def it():
@@ -871,6 +889,15 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 "perf/restore/verify_cached_bytes": rs["bytes_cached"],
                 "perf/restore/verify_ms": rs["verify_ms"],
             })
+        rr = ckpt.last_reshard
+        if rr is not None:
+            # cross-topology restore (ISSUE 12): reshard cost joins the
+            # startup breakdown so tools/bench_startup.py's cross arm can
+            # report it alongside TTFS
+            row.update({
+                "perf/restore/reshard_ms": rr["reshard_ms"],
+                "perf/restore/reshard_leaves": rr["leaves"],
+            })
         if chief:
             import json as _json
 
@@ -880,6 +907,21 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
             if cache_dir is not None or cfg.aot_warmup:
                 svc.submit(lambda s=step, r=dict(row):
                            writer.write_scalars(s, r), tag="startup")
+            if rr is not None:
+                # gated by the reshard EVENT itself (never by warm-start
+                # knobs): same-topology streams stay byte-identical —
+                # sidecar present, keys absent (the parity contract's
+                # absent-until-event clause, like anomaly/rollbacks)
+                erow = {
+                    "elastic/resharded": 1.0,
+                    "elastic/saved_processes": rr["saved_processes"],
+                    "elastic/saved_devices": rr["saved_devices"],
+                    "elastic/host_stage": rr["host_stage"],
+                    "perf/restore/reshard_ms": rr["reshard_ms"],
+                    "perf/restore/reshard_leaves": rr["leaves"],
+                }
+                svc.submit(lambda s=step, r=erow:
+                           writer.write_scalars(s, r), tag="elastic")
 
     def _health_extras() -> dict:
         """Recovery counters riding the scalar rows — absent until nonzero,

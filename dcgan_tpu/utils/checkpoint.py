@@ -232,6 +232,15 @@ class Checkpointer:
         self.save_interval_secs = save_interval_secs
         self.save_interval_steps = save_interval_steps
         self._next_save = time.time() + save_interval_secs
+        # reshard accounting of the last restore (ISSUE 12): None when the
+        # same-topology path ran (the default; sidecar present but not
+        # needed), else {"reshard_ms", "host_stage", "saved_processes",
+        # "saved_devices", "leaves"} — the trainer's elastic/* row and
+        # tools/bench_startup.py's cross-topology arm read it
+        self.last_reshard: Optional[Dict[str, float]] = None
+        # sharding sidecars captured at save() time, written (chief-only)
+        # once their step finalizes — see _stash_sidecar
+        self._pending_sidecars: Dict[int, Dict] = {}
         # checksum-pass parallelism for the fused verified restore; the
         # env override exists for hosts whose storage saturates earlier
         self.verify_threads = max(1, int(os.environ.get(
@@ -246,9 +255,46 @@ class Checkpointer:
         self._mgr.save(int(step),
                        args=self._ocp.args.StandardSave(state),
                        force=force)
+        # the sharding sidecar (ISSUE 12) is derived from the live tree's
+        # NamedShardings NOW (the arrays may be donated away by the next
+        # step program) and written once the step FINALIZES, beside its
+        # integrity manifest — an in-flight async step has no dir yet and
+        # the stale-pruner must keep treating dirless files as garbage
+        self._stash_sidecar(step, state)
         # manifest any step finalized by now (with async saves that is the
         # PREVIOUS save — this step's manifest lands on the next call/wait)
         self._write_pending_manifests()
+
+    # -- sharding sidecar (ISSUE 12) -----------------------------------------
+
+    def _stash_sidecar(self, step: int, state: Pytree) -> None:
+        """Capture the saving topology for `step`: logical per-leaf specs
+        + mesh axis names/sizes + process count (elastic/sidecar.py
+        schema). Chief-only like the manifests; host/np trees (no
+        NamedShardings) simply get none — absence restores exactly as
+        before, same-topology."""
+        if jax.process_index() != 0:
+            return
+        from dcgan_tpu.elastic import sidecar as _sidecar
+
+        payload = _sidecar.build_payload(state)
+        if payload is not None:
+            self._pending_sidecars[int(step)] = payload
+
+    def _write_sidecar(self, step: int, payload: Dict) -> None:
+        from dcgan_tpu.elastic import sidecar as _sidecar
+        from dcgan_tpu.utils.retry import retry_io
+
+        path = _sidecar.sidecar_path(self.directory, step)
+
+        def _write():
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+
+        retry_io(_write, tag="ckpt-sidecar")
 
     # -- integrity manifests -------------------------------------------------
 
@@ -279,16 +325,23 @@ class Checkpointer:
             return
         from dcgan_tpu.utils.retry import retry_io
 
-        # prune manifests whose step Orbax retention already deleted (keep
-        # the manifest beside a .corrupt dir — forensics)
+        # prune manifests AND sharding sidecars whose step Orbax retention
+        # already deleted (keep both beside a .corrupt dir — forensics)
         int_dir = os.path.join(self.directory, INTEGRITY_DIRNAME)
+
+        def _stem(name: str) -> str:
+            if name.endswith(".sharding.json"):
+                return name[:-len(".sharding.json")]
+            return name[:-5] if name.endswith(".json") else ""
+
         try:
             stale = [n for n in os.listdir(int_dir)
-                     if n.endswith(".json") and n[:-5].isdigit()
+                     if _stem(n).isdigit()
                      and not os.path.exists(
-                         os.path.join(self.directory, n[:-5]))
+                         os.path.join(self.directory, _stem(n)))
                      and not os.path.exists(
-                         os.path.join(self.directory, n[:-5] + ".corrupt"))]
+                         os.path.join(self.directory,
+                                      _stem(n) + ".corrupt"))]
         except OSError:
             stale = []
         for name in stale:
@@ -298,6 +351,11 @@ class Checkpointer:
                 pass
 
         for step in self._finalized_steps():
+            # the step's stashed sharding sidecar lands with (before) its
+            # manifest — both describe a now-durable step
+            payload = self._pending_sidecars.pop(step, None)
+            if payload is not None:
+                self._write_sidecar(step, payload)
             path = self._manifest_path(step)
             if os.path.exists(path):
                 continue
@@ -539,11 +597,18 @@ class Checkpointer:
                 # the manifest must die with the step: a REPLAYED save at
                 # this step number writes different bytes, and verifying
                 # them against the stale manifest would falsely mark the
-                # good checkpoint .corrupt at the next restore
-                try:
-                    os.remove(self._manifest_path(s))
-                except OSError:
-                    pass
+                # good checkpoint .corrupt at the next restore (the
+                # sharding sidecar likewise — a replayed save re-records
+                # its topology fresh)
+                from dcgan_tpu.elastic import sidecar as _sidecar
+
+                for stale_path in (self._manifest_path(s),
+                                   _sidecar.sidecar_path(self.directory,
+                                                         s)):
+                    try:
+                        os.remove(stale_path)
+                    except OSError:
+                        pass
         if multi:
             import numpy as np
             from jax.experimental import multihost_utils
@@ -603,20 +668,71 @@ class Checkpointer:
         whose checksums fail, the exception is just corruption showing up
         twice). Verdicts stay deterministic across processes — every
         process hashes the same shared-filesystem bytes — so the
-        quarantine/fallback branch is taken symmetrically, like before."""
-        abstract = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                           sharding=getattr(x, "sharding",
-                                                            None))
-            if hasattr(x, "shape") else x,
-            target_state)
+        quarantine/fallback branch is taken symmetrically, like before.
+
+        ELASTIC (ISSUE 12): each candidate step's sharding sidecar
+        (written at save time beside the integrity manifest) names the
+        SAVING topology; when it differs from the target tree's — a
+        preempted 32-chip job resuming as 16, a 2-process save resumed by
+        1 — the restore RESHARDS instead of failing deep inside the array
+        reader. Same process count: the read itself is directed at the
+        current NamedShardings (each process pulls exactly its new
+        shards). Different process count: the arrays restore host-side
+        (numpy, full arrays, no device staging copy) and
+        `make_array_from_callback` uploads each device's shard
+        (elastic/reshard.py). Verification, quarantine fallback, and the
+        donation-safety rebase are IDENTICAL on both paths; a missing or
+        unreadable sidecar — or a matching topology — takes the exact
+        pre-elastic path, so same-topology restores are byte-identical in
+        behavior (the parity contract). `last_reshard` records the event.
+        """
+        from dcgan_tpu.elastic import reshard as _reshard
+        from dcgan_tpu.elastic import sidecar as _sidecar
+
+        self.last_reshard = None
+        abstract = _reshard.device_abstract(target_state)
         for step in self._finalized_steps():
+            # topology decision first: zero payload bytes move before the
+            # reshard-vs-direct choice is made
+            payload = _sidecar.read(self.directory, step)
+            mismatch = _sidecar.topology_mismatch(payload, target_state) \
+                if payload is not None else None
+            step_abstract, assemble, reshard_info = abstract, None, None
+            if mismatch is not None:
+                saved_procs = int(payload.get("process_count", 1))
+                saved_devices = 1
+                for s in payload["mesh"]["sizes"]:
+                    saved_devices *= int(s)
+                host_stage = saved_procs != jax.process_count()
+                if host_stage:
+                    step_abstract = _reshard.host_abstract(target_state)
+                    assemble = lambda t: _reshard.put_host_tree(
+                        t, target_state)
+                print(f"[dcgan_tpu] cross-topology restore of step {step}: "
+                      f"{mismatch} — resharding via the sharding sidecar "
+                      f"({'host-staged' if host_stage else 'device-read'} "
+                      f"path)", flush=True)
+                reshard_info = {
+                    "host_stage": 1.0 if host_stage else 0.0,
+                    "saved_processes": float(saved_procs),
+                    "saved_devices": float(saved_devices),
+                    "leaves": float(len(jax.tree_util.tree_leaves(
+                        target_state))),
+                }
             files, why = self._manifest_files(step)
             if files is None:
                 # unverified restore (legacy/unreadable-manifest step):
                 # exactly the seed's semantics, exceptions propagate
+                t0 = time.perf_counter()
                 restored = self._mgr.restore(
-                    step, args=self._ocp.args.StandardRestore(abstract))
+                    step,
+                    args=self._ocp.args.StandardRestore(step_abstract))
+                if assemble is not None:
+                    restored = assemble(restored)
+                if reshard_info is not None:
+                    reshard_info["reshard_ms"] = \
+                        (time.perf_counter() - t0) * 1e3
+                    self.last_reshard = reshard_info
                 return _rebase_onto_xla_buffers(restored) \
                     if persistent_cache_active() else restored
             bad = self._stat_precheck(step, files)
@@ -648,7 +764,13 @@ class Checkpointer:
             restored, restore_err = None, None
             try:
                 restored = self._mgr.restore(
-                    step, args=self._ocp.args.StandardRestore(abstract))
+                    step,
+                    args=self._ocp.args.StandardRestore(step_abstract))
+                if assemble is not None:
+                    # host-staged reshard: upload each device's shard of
+                    # the target sharding from the numpy staging tree —
+                    # part of the restore wall-clock it replaces
+                    restored = assemble(restored)
             except Exception as e:  # verdict decides if this is corruption
                 restore_err = e
             restore_ms = (time.perf_counter() - t0) * 1e3
@@ -672,6 +794,9 @@ class Checkpointer:
                 raise restore_err
             stats["restore_ms"] = restore_ms
             self.last_restore_stats = stats
+            if reshard_info is not None:
+                reshard_info["reshard_ms"] = restore_ms
+                self.last_reshard = reshard_info
             return _rebase_onto_xla_buffers(restored) \
                 if persistent_cache_active() else restored
         return None

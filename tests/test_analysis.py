@@ -1016,3 +1016,43 @@ class TestSemanticBaselineAndChecks:
         assert "coordination::stop_consensus" in by_name
         text = mlib.dumps(recs)
         assert mlib.loads(text) == sorted(recs, key=lambda r: r.name)
+
+
+class TestSpecCoverage:
+    """DCG011 (ISSUE 12): every model family's full train state must
+    match exactly one sharding-rule row — unmatched and multiply-matched
+    paths are findings. The clean case doubles as the committed table's
+    coverage proof (tests/test_elastic.py pins the engine semantics)."""
+
+    def test_committed_table_is_clean(self):
+        assert semantic.check_spec_coverage() == []
+
+    def test_removed_rule_reports_unmatched(self, monkeypatch):
+        from dcgan_tpu.elastic import rules as rmod
+
+        pruned = tuple(r for r in rmod.PARTITION_RULES
+                       if r[0] != r"(^|/)proj/w$")
+        monkeypatch.setattr(rmod, "PARTITION_RULES", pruned)
+        fs = semantic.check_spec_coverage()
+        assert fs and all(f.check == "DCG011" for f in fs)
+        assert any("spec-unmatched" in f.key and "proj/w" in f.key
+                   for f in fs)
+        # params, BOTH Adam moments, and the EMA mirror all lose coverage
+        keys = "\n".join(f.key for f in fs)
+        for stem in ("params/gen/proj/w", "opt/gen/1/0/mu/proj/w",
+                     "opt/gen/1/0/nu/proj/w"):
+            assert stem in keys
+
+    def test_overlapping_rule_reports_ambiguous(self, monkeypatch):
+        from dcgan_tpu.elastic import rules as rmod
+
+        widened = rmod.PARTITION_RULES + (
+            (r"(^|/)proj/w$", (None, None)),)
+        monkeypatch.setattr(rmod, "PARTITION_RULES", widened)
+        fs = semantic.check_spec_coverage()
+        assert any(f.check == "DCG011" and "spec-ambiguous" in f.key
+                   and "proj/w" in f.key for f in fs)
+
+    def test_dcg011_redirected_from_ast_driver(self):
+        with pytest.raises(ValueError, match="--semantic"):
+            run({"dcgan_tpu/x.py": "x = 1\n"}, checks=["DCG011"])

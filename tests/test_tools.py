@@ -509,6 +509,45 @@ class TestPipelineRollbackSmoke:
 
 
 @pytest.mark.chaos
+class TestElasticShrinkSmoke:
+    """ISSUE 12's tier-1 pin (chaos-marker pattern): a checkpoint saved
+    by 2 processes must resume on 1 process (2 virtual devices — same
+    2-way data mesh, different process census) through the sharding
+    sidecar's host-staged reshard, with post-resume losses and final
+    STATE_SUM replaying BIT-EXACTLY against a same-topology control
+    resume — through real trainer subprocesses, inside an explicit
+    runtime budget. The grow direction (and the rest of the matrix) runs
+    standalone: `JAX_PLATFORMS=cpu python tools/chaos_drill.py`."""
+
+    def test_elastic_shrink_within_budget(self):
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "tools/chaos_drill.py", "--only",
+             "elastic-shrink"],
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        elapsed = time.monotonic() - t0
+        lines = [json.loads(l) for l in res.stdout.splitlines()
+                 if l.startswith("{")]
+        summary = lines[-1]
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
+        assert summary["scenarios"] == 1 and summary["failed"] == 0
+        scenarios = {p["scenario"]: p for p in lines if "scenario" in p}
+        assert set(scenarios) == {"elastic-shrink"}
+        row = scenarios["elastic-shrink"]
+        assert row["direction"] == "2proc->1proc"
+        assert row["replay_bit_exact"] is True
+        assert row["final_step"] == 6
+        assert row["reshard_ms"] > 0
+        # five tiny trainer launches (one 2-proc save pair, a 1-proc
+        # cross resume, a 2-proc control pair; ~20 s measured total on a
+        # quiet host) — generous headroom for CI contention
+        assert elapsed < 300, f"elastic-shrink smoke took {elapsed:.0f}s"
+
+
+@pytest.mark.chaos
 class TestBenchStartupSmoke:
     """tools/bench_startup.py --smoke pinned into tier-1 (ISSUE 5,
     mirroring the chaos_drill pattern): the cold-vs-warm trainer A/B must
@@ -535,7 +574,13 @@ class TestBenchStartupSmoke:
         assert row["checks"]["warm_zero_misses"]
         assert row["checks"]["restore_bytes_read_once"]
         assert row["warm"]["cache"]["hits"] > 0
-        # two tiny trainer subprocesses; ~4x measured cost on a quiet host
+        # the cross-topology arm (ISSUE 12): save@2-dev -> restore@1-dev
+        # must take the sidecar reshard path, and the same-topology warm
+        # arm must NOT
+        assert row["checks"]["cross_resharded"]
+        assert row["checks"]["warm_no_reshard"]
+        assert row["cross"]["reshard_ms"] > 0
+        # three tiny trainer subprocesses; ~4x measured cost (quiet host)
         assert elapsed < 240, f"bench_startup smoke took {elapsed:.0f}s"
 
 

@@ -9,7 +9,13 @@ against one persistent compile cache:
       compiles, the cache is primed, a final checkpoint lands;
   arm "warm": same cache dir, same checkpoint dir — the restart path:
       programs deserialize from the primed cache, the checkpoint restores
-      through the fused single-pass verified read.
+      through the fused single-pass verified read;
+  arm "cross" (ISSUE 12): a CLONE of that checkpoint restored on HALF the
+      devices (2 -> 1) — the elastic-topology restart path: the sharding
+      sidecar detects the mesh change and the restore reshards through
+      the rule engine, reporting perf/restore/reshard_ms alongside the
+      cold/warm TTFS row (cold/warm are pinned to 2 virtual devices so
+      the cross arm is a real topology change on any host).
 
 and emits ONE BENCH-style JSON line with each arm's startup breakdown
 (init / data / restore / compile / time-to-first-step, parsed from the
@@ -45,8 +51,11 @@ STARTUP_PREFIX = "perf/startup/"
 
 
 def _run_arm(name: str, *, workdir: str, cache_dir: str, ckpt_dir: str,
-             max_steps: int, size: int, batch: int, timeout: float) -> dict:
-    """One trainer subprocess; returns its parsed perf/ startup event."""
+             max_steps: int, size: int, batch: int, timeout: float,
+             device_count: int = 2) -> dict:
+    """One trainer subprocess pinned to `device_count` virtual CPU
+    devices (a full XLA_FLAGS replace — the ambient test env may pin 8);
+    returns its parsed perf/ startup event."""
     argv = [
         sys.executable, "-m", "dcgan_tpu.train",
         "--synthetic",
@@ -65,9 +74,12 @@ def _run_arm(name: str, *, workdir: str, cache_dir: str, ckpt_dir: str,
         "--sample_dir", os.path.join(workdir, f"samples-{name}"),
     ]
     t0 = time.perf_counter()
-    res = subprocess.run(argv, cwd=REPO,
-                         env=dict(os.environ, JAX_PLATFORMS="cpu"),
-                         capture_output=True, text=True, timeout=timeout)
+    res = subprocess.run(
+        argv, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu",
+                 XLA_FLAGS="--xla_force_host_platform_device_count="
+                           f"{device_count}"),
+        capture_output=True, text=True, timeout=timeout)
     wall_ms = (time.perf_counter() - t0) * 1e3
     if res.returncode != 0:
         raise RuntimeError(
@@ -133,7 +145,8 @@ def main() -> None:
     with tempfile.TemporaryDirectory() as tmp:
         cache = os.path.join(tmp, "compile-cache")
         ckpt = os.path.join(tmp, "ckpt")
-        cold = _run_arm("cold", workdir=tmp, cache_dir=cache, ckpt_dir=ckpt,
+        cold = _run_arm("cold", workdir=tmp, cache_dir=cache,
+                        ckpt_dir=ckpt,
                         max_steps=steps, size=size, batch=batch,
                         timeout=args.timeout)
         # the cold arm's final save is at `steps` — the step warm restores
@@ -141,8 +154,22 @@ def main() -> None:
         warm = _run_arm("warm", workdir=tmp, cache_dir=cache, ckpt_dir=ckpt,
                         max_steps=2 * steps, size=size, batch=batch,
                         timeout=args.timeout)
+        # cross-topology arm (ISSUE 12): the warm arm's final save (made
+        # on 2 devices) restored on 1 — a CLONE, so the reshard arm can
+        # never contaminate the warm dir; the sidecar drives a
+        # device-read reshard and the startup event reports its cost
+        sys.path.insert(0, REPO)
+        from dcgan_tpu.testing.chaos import clone_checkpoint_dir
+
+        ckpt_x = clone_checkpoint_dir(ckpt, os.path.join(tmp, "ckpt-cross"))
+        cross = _run_arm("cross", workdir=tmp, cache_dir=cache,
+                         ckpt_dir=ckpt_x, max_steps=3 * steps, size=size,
+                         batch=batch, timeout=args.timeout,
+                         device_count=1)
 
     c, w = _breakdown(cold), _breakdown(warm)
+    x = _breakdown(cross)
+    xp = cross["perf"]
     wp = warm["perf"]
     verify_read = wp.get("perf/restore/verify_bytes", -1.0)
     verify_cached = wp.get("perf/restore/verify_cached_bytes", 0.0)
@@ -159,13 +186,23 @@ def main() -> None:
         "restore_bytes_read_once":
             0 <= verify_read <= manifest_bytes
             and verify_read + verify_cached == manifest_bytes,
+        # the cross arm actually took the elastic reshard path (and the
+        # same-topology warm arm did NOT — sidecar present, path untaken)
+        "cross_resharded": xp.get("perf/restore/reshard_ms", 0.0) > 0,
+        "warm_no_reshard": "perf/restore/reshard_ms" not in wp,
+        "cross_resumed": cross["resumed"],
     }
     row = {
         "label": "bench-startup",
         "platform": "cpu",
         "model": f"dcgan{size}", "batch": batch, "steps": steps,
+        "devices": {"cold": 2, "warm": 2, "cross": 1},
         "cold": c,
         "warm": w,
+        "cross": dict(
+            x, reshard_ms=round(xp.get("perf/restore/reshard_ms", 0.0), 1),
+            reshard_leaves=int(
+                xp.get("perf/restore/reshard_leaves", 0.0))),
         "restore": {
             "manifest_bytes": manifest_bytes,
             "verify_bytes_read": verify_read,

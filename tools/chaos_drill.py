@@ -53,6 +53,14 @@ never silently trains garbage, never hangs.
                                                          queue drains, report
                                                          lands, clean exit 0
                                                          (ISSUE 9)
+    elastic-shrink        2-proc save resumed by 1       sidecar-driven
+                          proc (2 devices — same mesh,   host-staged reshard;
+                          different process census)      losses + STATE_SUM
+                                                         replay BIT-EXACT vs
+                                                         a 2-proc control
+                                                         resume (ISSUE 12)
+    elastic-grow          1-proc (2-device) save         same contract, the
+                          resumed by 2 procs            other direction
 
 Multi-host matrix (ISSUE 4, `--multihost`): the same contract under a REAL
 2-process jax.distributed job over localhost gRPC (tests/multihost_worker.py
@@ -110,14 +118,22 @@ sys.path.insert(0, REPO)
 SMOKE_SCENARIOS = ("corrupt-record", "io-error-once", "services-crash")
 
 _DRIVER = """
+import os
 import jax; jax.config.update("jax_platforms", "cpu")
+if os.environ.get("DRILL_THREEFRY_PARTITIONABLE"):
+    # the elastic cross-topology arms compare losses bit-exactly against
+    # 2-process phases, whose workers standardize on partitionable
+    # threefry (testing/multihost.py) — the flag changes the generated
+    # random STREAM, so both layouts must agree on it
+    jax.config.update("jax_threefry_partitionable", True)
 from dcgan_tpu.config import ModelConfig, TrainConfig
 from dcgan_tpu.train.trainer import train
+base = dict(batch_size=8, tensorboard=False, sample_every_steps=0,
+            save_summaries_secs=0.0, log_every_steps=1)
+base.update({extra!r})  # scenario overrides WIN over the driver defaults
 cfg = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
                                     compute_dtype="float32"),
-                  batch_size=8, tensorboard=False, sample_every_steps=0,
-                  save_summaries_secs=0.0, log_every_steps=1,
-                  **{extra!r})
+                  **base)
 state = train(cfg, synthetic_data={synthetic!r}, max_steps={max_steps!r})
 import numpy as np
 total = sum(float(np.abs(np.asarray(jax.device_get(leaf),
@@ -571,12 +587,13 @@ jax.distributed.initialize(
 import numpy as np
 from dcgan_tpu.config import ModelConfig, TrainConfig
 from dcgan_tpu.train.trainer import train
+base = dict(batch_size=8, tensorboard=False, sample_every_steps=0,
+            activation_summary_steps=0, save_summaries_secs=1e9,
+            log_every_steps=1, save_model_steps=10_000)
+base.update(json.loads(os.environ["MH_EXTRA"]))  # scenario overrides WIN
 cfg = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
                                     compute_dtype="float32"),
-                  batch_size=8, tensorboard=False, sample_every_steps=0,
-                  activation_summary_steps=0, save_summaries_secs=1e9,
-                  log_every_steps=1, save_model_steps=10_000,
-                  **json.loads(os.environ["MH_EXTRA"]))
+                  **base)
 state = train(cfg, synthetic_data=True,
               max_steps=int(os.environ["MH_MAX_STEPS"]))
 total = sum(float(np.abs(np.asarray(jax.device_get(leaf),
@@ -762,6 +779,168 @@ MH_SCENARIOS = {
     "mh-sigterm-stop": scenario_mh_sigterm_stop,
     "mh-watchdog": scenario_mh_watchdog,
 }
+
+
+# -- elastic-topology scenarios (ISSUE 12) -----------------------------------
+#
+# A checkpoint saved on one topology resumes on another THROUGH the
+# sharding sidecar + rule-engine reshard (utils/checkpoint.py,
+# dcgan_tpu/elastic/). Both directions pin the strongest contract
+# available on CPU: the shrink/grow pair keeps the MESH identical (2-way
+# "data" axis) and changes only the process census (2 proc x 1 dev <->
+# 1 proc x 2 dev), so the compiled SPMD programs — and therefore the
+# post-resume losses — must replay BIT-EXACTLY against a same-topology
+# control resume of the same checkpoint. `synthetic_global_stream` makes
+# the data stream layout-invariant (every process draws the full global
+# batch and cuts its block), which is what makes that comparison
+# meaningful. The scenarios live in the single-process matrix: each
+# orchestrates its own 2-process phases.
+
+#: knobs common to every elastic arm — scalar rows every step (the loss
+#: replay is diffed from events.jsonl), no periodic saves (one final save
+#: per phase), layout-invariant synthetic stream
+_ELASTIC_KNOBS = dict(save_summaries_secs=0.0, save_model_secs=1e9,
+                      save_model_steps=10_000, activation_summary_steps=0,
+                      synthetic_global_stream=True)
+
+#: a single process with TWO virtual CPU devices — the other layout of
+#: the same 2-way data mesh the 2-process phases train on (full replace,
+#: not append: the ambient test env may pin 8 devices). Partitionable
+#: threefry matches the multihost workers' standard, so the two layouts
+#: draw identical random streams (the bit-exact replay rides on it).
+_TWO_DEV_ENV = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "DRILL_THREEFRY_PARTITIONABLE": "1"}
+
+
+def _loss_rows(events) -> dict:
+    """{step: (d_loss, g_loss)} from scalar rows — the replay record."""
+    return {e["step"]: (e["values"]["d_loss"], e["values"]["g_loss"])
+            for e in events
+            if e["kind"] == "scalars" and "d_loss" in e["values"]}
+
+
+def _elastic_scenario(root: str, *, shrink: bool) -> dict:
+    """Save at 3 steps on the source topology, then resume to step 6 twice
+    from clones of that checkpoint: once on the OTHER process layout
+    (cross arm — must reshard through the sidecar's host-staged path) and
+    once on the saving layout (control arm — sidecar present, reshard
+    path NOT taken). Post-resume losses and final STATE_SUM must match
+    bit-exactly; elastic/* keys must appear in the cross arm's events
+    and nowhere in the control's."""
+    from dcgan_tpu.testing.chaos import clone_checkpoint_dir
+
+    ck = os.path.join(root, "ck")
+    name = "shrink" if shrink else "grow"
+
+    def run_two_proc(ckpt_dir, max_steps):
+        results = _run_mh_train(
+            dict(checkpoint_dir=ckpt_dir,
+                 sample_dir=os.path.join(root, "sm"), **_ELASTIC_KNOBS),
+            max_steps=max_steps)
+        for pid, (rc, out) in enumerate(results):
+            _check(rc == 0, f"{name}: 2-proc process {pid} failed "
+                            f"(rc={rc}): {out[-800:]}")
+            _check(f"TRAIN_DONE step={max_steps}" in out,
+                   f"{name}: 2-proc process {pid} did not reach step "
+                   f"{max_steps}: {out[-400:]}")
+        return results[0][1]  # the chief's output (it logs and writes)
+
+    def run_one_proc(ckpt_dir, max_steps):
+        rc, out = _run_train(
+            dict(checkpoint_dir=ckpt_dir,
+                 sample_dir=os.path.join(root, "sm"), **_ELASTIC_KNOBS),
+            max_steps=max_steps, env_extra=_TWO_DEV_ENV)
+        _check(rc == 0,
+               f"{name}: 1-proc trainer failed (rc={rc}): {out[-800:]}")
+        _check(f"TRAIN_DONE step={max_steps}" in out,
+               f"{name}: 1-proc run did not reach step {max_steps}: "
+               f"{out[-400:]}")
+        return out
+
+    save, resume_cross = (run_two_proc, run_one_proc) if shrink \
+        else (run_one_proc, run_two_proc)
+
+    # phase A: train 3 steps on the source topology; the final forced
+    # save carries the sharding sidecar
+    save(ck, 3)
+    _check(os.path.exists(os.path.join(ck, "integrity",
+                                       "3.sharding.json")),
+           f"{name}: no sharding sidecar beside the step-3 manifest")
+    ck_cross = clone_checkpoint_dir(ck, os.path.join(root, "ck-cross"))
+    ck_ctrl = clone_checkpoint_dir(ck, os.path.join(root, "ck-control"))
+
+    # cross arm: the OTHER process layout of the same 2-way data mesh —
+    # the process census changed, so the reshard must take the
+    # host-staged path
+    out_cross = resume_cross(ck_cross, 6)
+    _check("cross-topology restore of step 3" in out_cross,
+           f"{name}: resume did not take the reshard path: "
+           f"{out_cross[-800:]}")
+    _check("host-staged path" in out_cross,
+           f"{name}: process-count change did not use the host-staged "
+           f"reshard: {out_cross[-800:]}")
+    _check("restored checkpoint at step 3" in out_cross,
+           f"{name}: cross arm did not restore step 3: {out_cross[-800:]}")
+
+    # control arm: the saving layout — sidecar present, reshard NOT taken
+    out_ctrl = save(ck_ctrl, 6)
+    _check("cross-topology restore" not in out_ctrl,
+           f"{name}: same-topology control unexpectedly resharded: "
+           f"{out_ctrl[-800:]}")
+    _check("restored checkpoint at step 3" in out_ctrl,
+           f"{name}: control arm did not restore step 3: "
+           f"{out_ctrl[-800:]}")
+
+    # bit-exact replay: the same mesh ran the same programs over the same
+    # (layout-invariant) batches — losses and final params must agree to
+    # the last bit, or the reshard changed the state it claimed to move
+    lx, lc = _loss_rows(_events(ck_cross)), _loss_rows(_events(ck_ctrl))
+    for s in (4, 5, 6):
+        _check(s in lx and s in lc,
+               f"{name}: missing step-{s} loss row (cross has "
+               f"{sorted(lx)}, control {sorted(lc)})")
+        _check(lx[s] == lc[s],
+               f"{name}: step-{s} losses diverged across topologies: "
+               f"cross {lx[s]} != control {lc[s]}")
+    sum_cross, sum_ctrl = _state_sum(out_cross), _state_sum(out_ctrl)
+    _check(sum_cross == sum_ctrl,
+           f"{name}: post-resume states diverged: {sum_cross} != "
+           f"{sum_ctrl}")
+
+    # key gating: the reshard event surfaces elastic/*; the control stream
+    # stays byte-identical in KEY SET to a pre-elastic resume
+    cross_elastic = [e for e in _events(ck_cross) if e["kind"] == "scalars"
+                     and "elastic/resharded" in e["values"]]
+    ctrl_elastic = [e for e in _events(ck_ctrl) if e["kind"] == "scalars"
+                    and any(k.startswith("elastic/") for k in e["values"])]
+    _check(cross_elastic, f"{name}: no elastic/* event row in the cross "
+                          "arm's stream")
+    _check(not ctrl_elastic, f"{name}: elastic/* keys leaked into the "
+                             f"same-topology control: {ctrl_elastic[:1]}")
+    row = cross_elastic[-1]["values"]
+    _check(row["elastic/host_stage"] == 1.0,
+           f"{name}: elastic row does not record the host-staged path: "
+           f"{row}")
+    return {"direction": "2proc->1proc" if shrink else "1proc->2proc",
+            "final_step": 6, "replay_bit_exact": True,
+            "reshard_ms": round(row["perf/restore/reshard_ms"], 1),
+            "state_sum": sum_cross}
+
+
+def scenario_elastic_shrink(root: str) -> dict:
+    """2-process save -> 1-process (2-device) resume: the preemptible-
+    fleet shrink. Bit-exact loss replay vs a 2-process control resume."""
+    return _elastic_scenario(root, shrink=True)
+
+
+def scenario_elastic_grow(root: str) -> dict:
+    """1-process (2-device) save -> 2-process resume: scale back out after
+    a degraded period. Bit-exact loss replay vs a 1-process control."""
+    return _elastic_scenario(root, shrink=False)
+
+
+SCENARIOS["elastic-shrink"] = scenario_elastic_shrink
+SCENARIOS["elastic-grow"] = scenario_elastic_grow
 
 
 def main(argv=None) -> int:
