@@ -113,6 +113,95 @@ def _bench_sample(cfg, pt, state, n_chips: int) -> None:
           f"ms_per_step={dt / n_calls * 1e3:.2f}", file=sys.stderr)
 
 
+def _state_mib_per_chip(state) -> float:
+    """Per-chip resident train-state MiB — the number the ZeRO ladder
+    moves (one shared derivation: parallel/sharding.state_bytes_per_chip,
+    also what the zero-stage tests pin)."""
+    from dcgan_tpu.parallel.sharding import state_bytes_per_chip
+
+    return round(state_bytes_per_chip(state) / 2**20, 2)
+
+
+def _time_arm(run, st, step_idx: int, windows: int):
+    """One A/B arm's timing harness, shared by the pipelined and ZeRO
+    rows so the two A/B methodologies cannot drift: a compile+warmup
+    call, then best-of-`windows` wall clock with a value-readback sync
+    per window (see main()'s sync rationale). `run(state, step_idx) ->
+    (state, metrics, step_idx)`. Returns (state, metrics, step_idx,
+    best_window_seconds)."""
+    st, metrics, step_idx = run(st, step_idx)        # compile + warmup
+    float(metrics["d_loss"])                         # value-readback sync
+    dt = float("inf")
+    for _ in range(windows):
+        t0 = time.perf_counter()
+        st, metrics, step_idx = run(st, step_idx)
+        float(metrics["d_loss"])
+        dt = min(dt, time.perf_counter() - t0)
+    return st, metrics, step_idx, dt
+
+
+def _bench_zero_ab(cfg, mesh, n_chips: int, images, base) -> None:
+    """ZERO_STAGE={2,3}: the state-sharding A/B row (ISSUE 13).
+
+    Measures the SAME config per-step at zero_stage 1 and each stage up
+    to ZERO_STAGE, and prints one extra BENCH-style row with every arm's
+    ms_per_step + peak_state_mib (per-chip resident state bytes from the
+    live shardings). The contract the acceptance rides on: peak_state_mib
+    strictly DECREASING from stage 1 -> 3 while throughput stays within
+    noise — the ZeRO win as a number, not a claim. Printed BEFORE the
+    headline row so the driver's last-line parse is unchanged.
+    """
+    import dataclasses
+
+    import jax
+
+    from dcgan_tpu.parallel import make_parallel_train
+
+    top = int(os.environ["ZERO_STAGE"])
+    steps = max(1, int(os.environ.get("BENCH_ZERO_STEPS",
+                                      min(STEPS_MEASURE, 60))))
+    windows = int(os.environ.get("BENCH_WINDOWS", 3))
+    arms = {}
+    for stage in [s for s in (1, 2, 3) if s <= top]:
+        cfg_s = dataclasses.replace(
+            cfg, mesh=dataclasses.replace(cfg.mesh, zero_stage=stage))
+        pt_s = make_parallel_train(cfg_s, mesh)
+        st = pt_s.init(jax.random.key(0))
+        peak_state = _state_mib_per_chip(st)
+
+        def run(st, step_idx, _pt=pt_s):
+            for _ in range(steps):
+                st, metrics = _pt.step(st, images,
+                                       jax.random.fold_in(base, step_idx))
+                step_idx += 1
+            return st, metrics, step_idx
+
+        st, _metrics, _idx, dt = _time_arm(run, st, 0, windows)
+        arms[f"zero{stage}"] = {
+            "ms_per_step": round(dt / steps * 1e3, 3),
+            "images_per_sec_chip": round(
+                cfg.batch_size * steps / dt / n_chips, 1),
+            "peak_state_mib": peak_state,
+        }
+        del st  # free the arm's state before the next arm compiles
+    arch = os.environ.get("BENCH_PRESET", "") or (
+        f"DCGAN-{cfg.model.output_size}")
+    z1, ztop = arms["zero1"], arms[f"zero{top}"]
+    print(json.dumps({
+        "metric": f"{arch} ZeRO state-sharding A/B (batch {BATCH}/chip, "
+                  "per-step dispatch, bf16)",
+        "value": ztop["images_per_sec_chip"],
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ztop["images_per_sec_chip"]
+                             / V100_TF_BASELINE_IMG_PER_SEC, 3),
+        **arms,
+        # the headline memory claim as one unitless number
+        "state_mib_zero1_over_top": round(
+            z1["peak_state_mib"] / ztop["peak_state_mib"], 3)
+        if ztop["peak_state_mib"] else None,
+    }))
+
+
 def _bench_pipeline_ab(cfg, pt, n_chips: int, images, base) -> None:
     """PIPELINE_GD=1: the pipelined G/D dispatch A/B row (ISSUE 7).
 
@@ -156,15 +245,7 @@ def _bench_pipeline_ab(cfg, pt, n_chips: int, images, base) -> None:
         # fresh state per arm (donation consumed the other arm's): arms
         # must not share optimizer history either
         st = pt.init(jax.random.key(0))
-        step_idx = 0
-        st, metrics, step_idx = run(st, step_idx)        # compile + warmup
-        float(metrics["d_loss"])                         # value-readback sync
-        dt = float("inf")
-        for _ in range(windows):
-            t0 = time.perf_counter()
-            st, metrics, step_idx = run(st, step_idx)
-            float(metrics["d_loss"])
-            dt = min(dt, time.perf_counter() - t0)
+        st, metrics, step_idx, dt = _time_arm(run, st, 0, windows)
         devstep = None
         if os.environ.get("BENCH_DEVSTEP", "1") != "0":
             try:
@@ -405,7 +486,18 @@ def main() -> None:
         # the capture failed); host ms_per_step minus this is transport +
         # host overhead, the split the captures log could not see before
         "devstep_ms": round(devstep_ms, 4) if devstep_ms else None,
+        # per-chip resident state footprint (ISSUE 13): the number the
+        # --zero_stage ladder moves; derived from the live shardings
+        "peak_state_mib": _state_mib_per_chip(state),
     }
+    if os.environ.get("ZERO_STAGE") in ("2", "3"):
+        # the ZeRO state-sharding A/B row (ISSUE 13) — printed before the
+        # headline row so the driver's last-line parse is unchanged
+        if mesh.shape["data"] < 2:
+            print("ZERO_STAGE skipped: stages >= 2 need a data axis of "
+                  "size > 1", file=sys.stderr)
+        else:
+            _bench_zero_ab(cfg, mesh, n_chips, images, base)
     if os.environ.get("PIPELINE_GD") == "1":
         # the pipelined G/D A/B row (ISSUE 7) — printed before the headline
         # row so the driver's last-line parse contract is unchanged

@@ -139,17 +139,20 @@ def _require_platform() -> None:
             "arranges the environment before jax initializes).")
 
 
-def small_config(backend: str = "gspmd", pipeline: bool = False):
+def small_config(backend: str = "gspmd", pipeline: bool = False,
+                 zero: int = 1):
     """The small CPU preset every program is lowered at: tiny dcgan16
     model, global batch 8 over the 2-way data mesh, every optional
     program's knob armed (sampler / probe / summarize / rollback with LR
-    backoff) so the warmup plan enumerates the full dispatch surface."""
+    backoff) so the warmup plan enumerates the full dispatch surface.
+    `zero` selects the ZeRO stage (ISSUE 13) — the 2-way data mesh is
+    exactly the canonical topology stages >= 2 need."""
     from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
 
     return TrainConfig(
         model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
                           compute_dtype="float32"),
-        mesh=MeshConfig(data=CANONICAL_DEVICES),
+        mesh=MeshConfig(data=CANONICAL_DEVICES, zero_stage=zero),
         batch_size=8,
         backend=backend,
         # pipeline_gd is config-validated to steps_per_call=1; the plain
@@ -402,6 +405,63 @@ def enumerate_audits() -> Tuple[List[ProgramAudit], List[CoverageRow]]:
             programs=frozenset(pt_p.programs),
             plan=tuple(n for n, _, _ in plan_p),
             must_cover=frozenset(stages)))
+
+        # ZeRO-2/3 variants (ISSUE 13): the state-sharded step programs —
+        # the census intentionally changes (shard_map gains explicit
+        # psum_scatter/all_gather rows; gspmd rows stay "0 explicit", the
+        # partitioner inserts theirs) and the donation audit must hold for
+        # every data-SHARDED donated leaf in both backends, including the
+        # LR-backoff rebuild variants. Only the step-family rows are
+        # traced (sampler/probe/summarize differ from the stage-1 rows
+        # only by the state gathers, which the stage rows already cover);
+        # the coverage rows still see the FULL warmup plan.
+        step_bases = {"train_step", "multi_step"}
+        for stage in (2, 3):
+            cfg_z = small_config(backend, zero=stage)
+            pt_z = make_parallel_train(cfg_z, mesh)
+            state_z = warmup.state_example(pt_z)
+            plan_z, _bkz = warmup.build_warmup_plan(
+                cfg_z, pt_z, state_z, sample_z=z, eval_z=z,
+                make_backoff_pt=lambda c, _m=mesh: make_parallel_train(
+                    c, _m))
+            cfg_zp = small_config(backend, pipeline=True, zero=stage)
+            pt_zp = make_parallel_train(cfg_zp, mesh)
+            plan_zp, _bkzp = warmup.build_warmup_plan(
+                cfg_zp, pt_zp, warmup.state_example(pt_zp), sample_z=None,
+                eval_z=None,
+                make_backoff_pt=lambda c, _m=mesh: make_parallel_train(
+                    c, _m))
+            zrows = [(n, f, a) for n, f, a in plan_z
+                     if _base(n) in step_bases]
+            zrows += [(n, f, a) for n, f, a in plan_zp
+                      if _base(n) in stages]
+            coverage.append(CoverageRow(
+                variant=f"{backend}+zero{stage}", path=path,
+                programs=frozenset(pt_z.programs),
+                plan=tuple(n for n, _, _ in plan_z),
+                must_cover=frozenset(
+                    {"train_step", f"multi_step@k{cfg_z.steps_per_call}",
+                     "sampler", "eval_losses", "summarize",
+                     "state_copy"})))
+            coverage.append(CoverageRow(
+                variant=f"{backend}+pipeline_gd+zero{stage}", path=path,
+                programs=frozenset(pt_zp.programs),
+                plan=tuple(n for n, _, _ in plan_zp),
+                must_cover=frozenset(stages)))
+            for n, f, a in zrows:
+                cadence = ""
+                if n == "train_step":
+                    cadence = (
+                        f"every step when `--zero_stage {stage}` "
+                        + ("(grads reduce-scatter onto the data axis, one "
+                           "fused all-gather rebuilds params per update)"
+                           if stage == 2 else
+                           "(stage 2's pattern + params resident sharded; "
+                           "just-in-time all-gather per forward)"))
+                audits.append(audit_callable(
+                    f"{backend}::{n}@zero{stage}", f, a, path=path,
+                    expect_donation=_base(n) in DONATED_PROGRAMS,
+                    cadence=cadence))
 
         for n, f, a in rows:
             cadence = ""
@@ -692,6 +752,42 @@ def check_spec_coverage() -> List[Finding]:
                             f"matches {len(hits)} rules ({pats}) — "
                             "first-match order is silently deciding its "
                             "spec; make the patterns disjoint"))
+        # grad-spec derivation (ISSUE 13): under ZeRO >= 2 a gradient leaf
+        # must resolve to EXACTLY the spec of the mu moment that consumes
+        # it — the reduce-scattered gradient is the shard-local Adam
+        # update's input with zero re-layout. Gradients are addressed by
+        # the bare param tail (rules.grad_shardings), moments by
+        # their full "opt/<net>/.../mu/<tail>" path; a rule row that keys
+        # on either prefix silently splits the two resolutions, so audit
+        # them against each other on the canonical 2-way mesh.
+        mesh_shape = {"data": CANONICAL_DEVICES, "model": 1}
+        for net in ("gen", "disc"):
+            for leaf_path, leaf in jax.tree_util.tree_flatten_with_path(
+                    shapes["params"][net])[0]:
+                tail = rules.path_str(leaf_path)
+                shape = tuple(getattr(leaf, "shape", ()))
+                try:
+                    gspec = rules.resolve_spec(
+                        rules.logical_spec(tail, len(shape)), shape,
+                        mesh_shape, zero=True)
+                    mspec = rules.resolve_spec(
+                        rules.logical_spec(f"opt/{net}/1/0/mu/{tail}",
+                                           len(shape)), shape,
+                        mesh_shape, zero=True)
+                except ValueError:
+                    continue  # unmatched leaves are already flagged above
+                if gspec != mspec:
+                    findings.append(Finding(
+                        check="DCG011", path=path, line=0,
+                        symbol=f"{variant}::grads",
+                        key=f"grad-spec-drift:{variant}:{net}/{tail}",
+                        message=f"[{variant}] gradient leaf "
+                                f"{net}/{tail!r} resolves to {gspec} but "
+                                f"its mu moment resolves to {mspec} — a "
+                                "rule row keys on the opt/ or params/ "
+                                "prefix, so the reduce-scattered gradient "
+                                "and the shard-local Adam state disagree "
+                                "on layout under zero_stage >= 2"))
     return findings
 
 

@@ -195,8 +195,39 @@ class MeshConfig:
                                    # its slice; reduce-scatter/all-gather
                                    # inserted by GSPMD — arXiv:2004.13336).
                                    # gspmd backend only
+    zero_stage: int = 1            # state-sharding stage (arXiv:2004.13336
+                                   # generalized): 1 = today's behavior
+                                   # (parity; shard_opt alone still gives
+                                   # ZeRO-1 on the gspmd backend). 2 =
+                                   # ZeRO-2: optimizer state AND gradients
+                                   # shard over the data axis — the full-
+                                   # gradient psum becomes a reduce-scatter,
+                                   # the Adam update runs shard-local, and
+                                   # one fused all-gather rebuilds the
+                                   # replicated params per update (same
+                                   # bytes on the wire as the all-reduce it
+                                   # replaces). 3 = ZeRO-3: params and the
+                                   # EMA copy additionally stay RESIDENT
+                                   # sharded between steps, all-gathered
+                                   # just in time inside each forward — the
+                                   # per-chip memory floor for params+grads+
+                                   # Adam state drops ~Nx on an N-way data
+                                   # axis. Both backends (gspmd via sharding
+                                   # constraints, shard_map via explicit
+                                   # psum_scatter/all_gather); stages >= 2
+                                   # need a data axis of size > 1 and reject
+                                   # spatial meshes (DESIGN.md §6i)
 
     def __post_init__(self):
+        if self.zero_stage not in (1, 2, 3):
+            raise ValueError(
+                f"zero_stage must be 1, 2, or 3, got {self.zero_stage}")
+        if self.zero_stage >= 2 and self.spatial:
+            raise ValueError(
+                "zero_stage >= 2 does not compose with spatial meshes "
+                "(spatial mode replicates all weights by policy — there is "
+                "no per-leaf dim left for the data-axis state shards); use "
+                "zero_stage=1 with spatial=True")
         if self.spatial and self.model <= 1:
             raise ValueError(
                 "spatial=True repurposes the 'model' mesh axis to shard image "
@@ -575,9 +606,18 @@ class TrainConfig:
                                             or self.mesh.shard_opt):
             raise ValueError(
                 "backend='shard_map' is data-parallel only (mesh.model must "
-                "be 1, spatial/shard_opt False — tensor/spatial/optimizer-"
-                f"state sharding live in the gspmd backend); got "
+                "be 1, spatial/shard_opt False — tensor/spatial/ZeRO-1 "
+                f"optimizer-state sharding live in the gspmd backend; "
+                f"ZeRO-2/3 is mesh.zero_stage, supported here); got "
                 f"mesh={self.mesh}")
+        if self.backend == "shard_map" and self.mesh.zero_stage >= 2 \
+                and self.grad_clip > 0:
+            raise ValueError(
+                "zero_stage >= 2 under backend='shard_map' does not compose "
+                "with grad_clip: the clip's global norm would be computed "
+                "over each replica's gradient SHARD (the explicit reduce-"
+                "scatter hands optax local slices) — use the gspmd backend, "
+                "where the partitioner computes the true global norm")
         if self.loss not in ("gan", "wgan-gp", "hinge"):
             raise ValueError(f"unknown loss {self.loss!r}")
         if self.update_mode not in ("sequential", "fused"):

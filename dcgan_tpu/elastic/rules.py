@@ -37,7 +37,14 @@ bit-for-bit — the semantic-tier program fingerprints must not move:
   same axis would force all-gathers around every conv);
 - `shard_opt=True` (ZeRO-1) additionally inserts the "data" axis on the
   first unsharded dim it divides, for optimizer-state paths only — the
-  cross-replica weight-update sharding of arXiv:2004.13336.
+  cross-replica weight-update sharding of arXiv:2004.13336;
+- `zero_stage >= 2` (ISSUE 13, DESIGN §6i) applies the same insertion —
+  via ONE shared `zero_insert` policy, with a co-sharding second pass for
+  dims already carrying mesh axes — to the optimizer state (stage 2) and
+  to params + the EMA mirror (stage 3), and derives the matching GRADIENT
+  specs (`grad_shardings`) and the shard_map backend's explicit
+  psum_scatter/all_gather dims (`zero_scatter_dims`) from the same table,
+  so the four layouts can never disagree.
 """
 
 from __future__ import annotations
@@ -149,9 +156,55 @@ def logical_spec(path: str, ndim: int,
     return rules[hits[0]][1]
 
 
+def zero_insert(parts: Sequence[Optional[str]], shape: Sequence[int],
+                mesh_shape, *, co_shard: bool = False
+                ) -> Tuple[Optional[int], Tuple[Any, ...]]:
+    """The data-axis insertion policy shared by every ZeRO stage: pad
+    `parts` to the leaf's rank and place DATA_AXIS on the first unsharded
+    dim with `size >= data_size` that it divides. Returns (dim, spec) —
+    dim None (and `parts` unpadded, matching the pre-engine derivation
+    bit-for-bit) when no dim is eligible. ONE definition serves the
+    optimizer-state shardings, the ZeRO-2 gradient specs, the ZeRO-3
+    param/EMA residency, and the shard_map backend's explicit
+    psum_scatter/all_gather dims, so the four can never disagree on where
+    a leaf splits.
+
+    co_shard=True (the ZeRO-2/3 form; ZeRO-1 keeps the historical
+    first-pass-only behavior so shard_opt placements never move) adds a
+    SECOND pass when no free dim divides: a dim already carrying mesh
+    axes takes DATA_AXIS as a trailing co-axis — `("model", "data")` on a
+    conv kernel's out-channels is the classic TP x ZeRO layout — when the
+    dim divides the combined axis product. Without this, any leaf whose
+    only large dim is model-annotated (e.g. the first conv's
+    [5, 5, c_dim, out] kernel) would silently stay replicated."""
+    if DATA_AXIS not in mesh_shape:
+        return None, tuple(parts)
+    data_size = int(mesh_shape[DATA_AXIS])
+    padded: List[Any] = \
+        list(parts) + [None] * (len(shape) - len(parts))
+    for d, (axis, size) in enumerate(zip(padded, shape)):
+        if axis is None and int(size) >= data_size \
+                and int(size) % data_size == 0:
+            padded[d] = DATA_AXIS
+            return d, tuple(padded)
+    if co_shard:
+        for d, (axis, size) in enumerate(zip(padded, shape)):
+            if axis is None:
+                continue
+            axes = (axis,) if isinstance(axis, str) else tuple(axis)
+            combined = data_size
+            for a in axes:
+                combined *= int(mesh_shape.get(a, 1))
+            if int(size) >= combined and int(size) % combined == 0:
+                padded[d] = axes + (DATA_AXIS,)
+                return d, tuple(padded)
+    return None, tuple(parts)
+
+
 def resolve_spec(spec: LogicalSpec, shape: Sequence[int], mesh_shape,
                  *, spatial: bool = False, shard_opt: bool = False,
-                 is_opt: bool = False) -> Tuple[Optional[str], ...]:
+                 is_opt: bool = False,
+                 zero: bool = False) -> Tuple[Optional[str], ...]:
     """One leaf's logical spec -> the concrete PartitionSpec entries
     (`P(*result)`) for the mesh at hand (`mesh_shape`: {axis: size}).
 
@@ -168,7 +221,11 @@ def resolve_spec(spec: LogicalSpec, shape: Sequence[int], mesh_shape,
     - ZeRO-1 (`shard_opt`, optimizer-state leaves only) pads the spec to
       the leaf's rank and inserts the data axis on the first unsharded
       dim with `size >= data_size` that it divides; no eligible dim
-      leaves the spec untouched (arXiv:2004.13336 as annotations)."""
+      leaves the spec untouched (arXiv:2004.13336 as annotations);
+    - `zero=True` applies the same insertion unconditionally — the
+      ZeRO-2/3 form, where the caller (state_shardings/grad_shardings)
+      decides which leaves the stage shards (opt at stage 2, plus
+      params/EMA at stage 3, gradients in both)."""
     shape = tuple(int(d) for d in shape)
     if spec is REPLICATED or len(shape) == 0 or spatial:
         parts: Tuple[Optional[str], ...] = ()
@@ -182,21 +239,31 @@ def resolve_spec(spec: LogicalSpec, shape: Sequence[int], mesh_shape,
                 keep = False
                 break
         parts = tuple(spec) if keep else ()
-    if shard_opt and is_opt and DATA_AXIS in mesh_shape:
-        data_size = int(mesh_shape[DATA_AXIS])
-        padded: List[Optional[str]] = \
-            list(parts) + [None] * (len(shape) - len(parts))
-        for d, (axis, size) in enumerate(zip(padded, shape)):
-            if axis is None and size >= data_size \
-                    and size % data_size == 0:
-                padded[d] = DATA_AXIS
-                return tuple(padded)
+    if zero or (shard_opt and is_opt):
+        d, padded = zero_insert(parts, shape, mesh_shape, co_shard=zero)
+        if d is not None:
+            return padded
     return parts
+
+
+def zero_targets_leaf(path: str, zero_stage: int) -> bool:
+    """Whether the ZeRO stage shards this STATE leaf over the data axis:
+    stage >= 2 takes the optimizer state (the ZeRO-2 shard-local update),
+    stage 3 additionally keeps params and the EMA mirror resident sharded
+    between steps. BN statistics and the step counter never shard — they
+    are updated inside the forward, not by the weight-update computation
+    this stage partitions (arXiv:2004.13336's scope), and they are a
+    rounding error of the state footprint."""
+    if zero_stage >= 2 and path.startswith("opt/"):
+        return True
+    return zero_stage >= 3 and (path.startswith("params/")
+                                or path.startswith("ema_gen"))
 
 
 def state_partition_specs(state_shapes: Pytree, mesh_shape, *,
                           spatial: bool = False,
-                          shard_opt: bool = False) -> Dict[str, Tuple]:
+                          shard_opt: bool = False,
+                          zero_stage: int = 1) -> Dict[str, Tuple]:
     """{path: resolved per-dim axis tuple} over a ShapeDtypeStruct tree —
     the flat, serializable form (the checkpoint sidecar stores exactly
     this). `mesh_shape` is {axis name: size}."""
@@ -209,12 +276,14 @@ def state_partition_specs(state_shapes: Pytree, mesh_shape, *,
         out[p] = resolve_spec(
             logical_spec(p, len(shape)), shape, mesh_shape,
             spatial=spatial, shard_opt=shard_opt,
-            is_opt=p.startswith("opt/"))
+            is_opt=p.startswith("opt/"),
+            zero=zero_targets_leaf(p, zero_stage))
     return out
 
 
 def state_shardings(state_shapes: Pytree, mesh, *, spatial: bool = False,
-                    shard_opt: bool = False) -> Pytree:
+                    shard_opt: bool = False,
+                    zero_stage: int = 1) -> Pytree:
     """ShapeDtypeStruct tree -> matching NamedSharding tree, via the rule
     table resolved against `mesh`. The engine form of the derivation
     `parallel/sharding.state_shardings` wraps (both backends and the
@@ -230,6 +299,91 @@ def state_shardings(state_shapes: Pytree, mesh, *, spatial: bool = False,
         parts = resolve_spec(
             logical_spec(p, len(shape)), shape, mesh_shape,
             spatial=spatial, shard_opt=shard_opt,
-            is_opt=p.startswith("opt/"))
+            is_opt=p.startswith("opt/"),
+            zero=zero_targets_leaf(p, zero_stage))
         return NamedSharding(mesh, P(*parts))
     return jax.tree_util.tree_map_with_path(to_sharding, state_shapes)
+
+
+def grad_shardings(param_shapes: Pytree, mesh) -> Pytree:
+    """NamedSharding tree for one net's GRADIENT tree under ZeRO >= 2
+    (the gspmd backend's reduce-scatter constraint targets): the same
+    rule rows as the params with the `zero_insert` data-axis policy
+    applied — a gradient leaf shards exactly like its mu/nu mirrors (the
+    tail of "opt/<net>/.../mu/<leaf>" matches the same row as "<leaf>",
+    audited by DCG011's grad-spec-derivation check), which is what makes
+    the reduce-scattered gradient the shard-local Adam update's input
+    with zero re-layout."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh_shape = dict(mesh.shape)
+
+    def to_sharding(path, leaf):
+        p = path_str(path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        parts = resolve_spec(logical_spec(p, len(shape)), shape, mesh_shape,
+                             zero=True)
+        return NamedSharding(mesh, P(*parts))
+    return jax.tree_util.tree_map_with_path(to_sharding, param_shapes)
+
+
+def zero_scatter_dims(param_shapes: Pytree, mesh_shape) -> Pytree:
+    """int tree over one net's params: the dim `zero_insert` places the
+    data axis on, -1 when the leaf stays replicated (-1, not None — None
+    is an empty pytree subtree and would break mapping this tree against
+    a gradient tree). The shard_map backend's explicit collectives read
+    this — psum_scatter's scatter_dimension and all_gather's axis must be
+    THE dim the NamedSharding derivation chose, or the stored shards and
+    the wire layout disagree."""
+    import jax
+
+    def to_dim(path, leaf):
+        p = path_str(path)
+        shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+        base = resolve_spec(logical_spec(p, len(shape)), shape, mesh_shape)
+        d, _ = zero_insert(base, shape, mesh_shape, co_shard=True)
+        return -1 if d is None else d
+    return jax.tree_util.tree_map_with_path(to_dim, param_shapes)
+
+
+def validate_zero_state(state_shapes: Pytree, mesh_shape, *,
+                        zero_stage: int) -> None:
+    """The mesh-concrete half of the zero_stage validation (the config
+    dataclass cannot see the device count). Raises when:
+
+    - stage >= 2 runs over a data axis of size 1 (every reduce-scatter
+      would be elided and the 'sharded' state would silently be the
+      replicated state — the knob must fail loudly, not no-op);
+    - a leaf the stage targets has >= 2x the data axis's elements yet NO
+      dim the axis divides — the stage's memory model silently degrades
+      for that leaf, so the error names it (leaves smaller than 2x the
+      axis replicate for free and are exempt)."""
+    import jax
+
+    data_size = int(mesh_shape.get(DATA_AXIS, 1))
+    if zero_stage >= 2 and data_size < 2:
+        raise ValueError(
+            f"zero_stage={zero_stage} shards state over the data axis, "
+            f"which needs size > 1 (got data={data_size}); use "
+            "zero_stage=1 on single-replica meshes")
+    if zero_stage < 2:
+        return
+    for path, leaf in jax.tree_util.tree_flatten_with_path(state_shapes)[0]:
+        p = path_str(path)
+        if not zero_targets_leaf(p, zero_stage):
+            continue
+        shape = tuple(int(d) for d in getattr(leaf, "shape", ()))
+        size = 1
+        for d in shape:
+            size *= d
+        if size < 2 * data_size:
+            continue
+        base = resolve_spec(logical_spec(p, len(shape)), shape, mesh_shape)
+        d, _ = zero_insert(base, shape, mesh_shape, co_shard=True)
+        if d is None:
+            raise ValueError(
+                f"zero_stage={zero_stage} cannot shard state leaf {p!r} "
+                f"(shape {shape}) over the {data_size}-way data axis: no "
+                f"dim is divisible by {data_size}. Pad the offending dim, "
+                "shrink the data axis, or drop to zero_stage=1")

@@ -214,13 +214,65 @@ def make_parallel_train(cfg: TrainConfig,
             return x if sh is None else \
                 jax.lax.with_sharding_constraint(x, sh)
 
+    # --- ZeRO-2/3 hooks (ISSUE 13, arXiv:2004.13336) ----------------------
+    # Under zero_stage >= 2 the step's gradient/update/forward sites get
+    # sharding constraints from the rule engine: grads constrained to the
+    # data-sharded ZeRO specs (the partitioner lowers the cross-replica sum
+    # as a reduce-scatter), the shard-local Adam updates constrained back
+    # to the resident param layout (stage 2: ONE fused all-gather rebuilds
+    # replicated params per update; stage 3: identity — params stay
+    # resident sharded and forwards gather just in time via gather_params).
+    zero = cfg.mesh.zero_stage
+    zero_hooks = None
+    shardings = None
+    if zero >= 2:
+        from dcgan_tpu.elastic import rules as _rules
+        from dcgan_tpu.train.steps import ZeroHooks, init_train_state
+
+        # one init trace + one residency derivation, shared with the jit
+        # wiring below (fns.init is the same function, so the shape tree
+        # is identical)
+        state_shapes = jax.eval_shape(
+            lambda k: init_train_state(k, cfg), jax.random.key(0))
+        _rules.validate_zero_state(state_shapes, dict(mesh.shape),
+                                   zero_stage=zero)
+        wsc = jax.lax.with_sharding_constraint
+        grad_sh = {net: _rules.grad_shardings(state_shapes["params"][net],
+                                              mesh)
+                   for net in ("gen", "disc")}
+        shardings = state_shardings(state_shapes, mesh, spatial=spatial,
+                                    shard_opt=cfg.mesh.shard_opt,
+                                    zero_stage=zero)
+        resident_sh = shardings["params"]
+
+        def _pin(tree, sh_tree):
+            return jax.tree_util.tree_map(lambda x, s: wsc(x, s),
+                                          tree, sh_tree)
+
+        if zero >= 3:
+            # the stage-1 param layout: what a forward's just-in-time
+            # gather rebuilds (stage 2 skips the gather — params are
+            # already resident in this layout)
+            base_sh = state_shardings(state_shapes, mesh, spatial=spatial,
+                                      shard_opt=cfg.mesh.shard_opt
+                                      )["params"]
+            gather_params = lambda p, net: _pin(p, base_sh[net])
+        else:
+            gather_params = lambda p, net: p
+        zero_hooks = ZeroHooks(
+            reduce_grads=lambda g, net: _pin(g, grad_sh[net]),
+            gather_updates=lambda u, net: _pin(u, resident_sh[net]),
+            gather_params=gather_params)
+
     fns = make_train_step(cfg, constrain_fake=constrain_fake,
                           constrain_micro=constrain_micro,
-                          attn_mesh=attn_mesh, pallas_mesh=pallas_mesh)
+                          attn_mesh=attn_mesh, pallas_mesh=pallas_mesh,
+                          zero_hooks=zero_hooks)
 
-    state_shapes = jax.eval_shape(fns.init, jax.random.key(0))
-    shardings = state_shardings(state_shapes, mesh, spatial=spatial,
-                                shard_opt=cfg.mesh.shard_opt)
+    if shardings is None:
+        state_shapes = jax.eval_shape(fns.init, jax.random.key(0))
+        shardings = state_shardings(state_shapes, mesh, spatial=spatial,
+                                    shard_opt=cfg.mesh.shard_opt)
     conditional = cfg.model.num_classes > 0
 
     init = jax.jit(fns.init, out_shardings=shardings)

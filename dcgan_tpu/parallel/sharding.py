@@ -39,6 +39,29 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def state_bytes_per_chip(state: Pytree) -> int:
+    """Per-chip RESIDENT bytes of a live state tree: each leaf counts its
+    per-device shard size (a rule-engine-sharded leaf — ZeRO stages, TP —
+    contributes 1/N, a replicated leaf contributes in full), derived from
+    the same NamedShardings the checkpoint sidecar records. ONE
+    definition: bench.py's `peak_state_mib` and the zero-stage tests'
+    strictly-decreasing ladder both read this, so the shipped metric and
+    the test that pins it cannot drift apart (ISSUE 13)."""
+    import jax
+    import numpy as np
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        sh = getattr(leaf, "sharding", None)
+        if sh is not None and hasattr(sh, "shard_shape"):
+            n = int(np.prod(sh.shard_shape(leaf.shape), dtype=np.int64)) \
+                if leaf.ndim else 1
+            total += n * leaf.dtype.itemsize
+        else:
+            total += int(getattr(leaf, "nbytes", 0))
+    return total
+
+
 def batch_sharding(mesh: Mesh, ndim: int = 4, *,
                    spatial: bool = False) -> NamedSharding:
     """Shard dim 0 (batch) over "data"; e.g. images [B,H,W,C], labels [B].
@@ -55,7 +78,8 @@ def batch_sharding(mesh: Mesh, ndim: int = 4, *,
 
 def state_shardings(state_shapes: Pytree, mesh: Mesh, *,
                     spatial: bool = False,
-                    shard_opt: bool = False) -> Pytree:
+                    shard_opt: bool = False,
+                    zero_stage: int = 1) -> Pytree:
     """Map a ShapeDtypeStruct tree (from jax.eval_shape on init) to a matching
     tree of NamedShardings. Works for the whole train state: params and Adam
     moments (mu/nu mirror the param tree, so the same path rules hit them) get
@@ -76,8 +100,15 @@ def state_shardings(state_shapes: Pytree, mesh: Mesh, *,
     under "opt") over the data axis where a dim divides — ZeRO-1: the memory
     and update-compute for Adam moments split across replicas instead of
     being redundantly materialized on each.
+
+    zero_stage >= 2 (ZeRO-2/3, ISSUE 13) extends the same insertion policy
+    beyond shard_opt's scope: stage 2 shards the optimizer state
+    unconditionally (gradients pick up the matching specs via
+    rules.grad_shardings inside the step), stage 3 additionally shards
+    params and the EMA mirror so they stay resident sharded between steps.
     """
     from dcgan_tpu.elastic import rules
 
     return rules.state_shardings(state_shapes, mesh, spatial=spatial,
-                                 shard_opt=shard_opt)
+                                 shard_opt=shard_opt,
+                                 zero_stage=zero_stage)

@@ -304,6 +304,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh_shard_opt", action="store_true",
                    help="ZeRO-1: shard optimizer state over the data axis "
                         "(reduce-scatter/all-gather weight updates)")
+    p.add_argument("--zero_stage", type=int, choices=[1, 2, 3], default=1,
+                   help="state-sharding stage (both backends): 1 = today's "
+                        "behavior (parity); 2 = gradients + optimizer state "
+                        "shard over the data axis (reduce-scatter grads, "
+                        "shard-local Adam, one fused all-gather rebuilds "
+                        "params per update); 3 = params + EMA additionally "
+                        "stay resident sharded between steps with a just-"
+                        "in-time all-gather inside each forward. Stages "
+                        ">= 2 need a data axis of size > 1")
     p.add_argument("--mesh_spatial", action="store_true",
                    help="use the model axis to shard image height instead of "
                         "weights (conv halo exchange; the sequence-parallel "
@@ -379,6 +388,7 @@ _FLAG_FIELDS = {
     "mesh_data": ("mesh", "data"), "mesh_model": ("mesh", "model"),
     "mesh_spatial": ("mesh", "spatial"), "backend": ("", "backend"),
     "mesh_shard_opt": ("mesh", "shard_opt"),
+    "zero_stage": ("mesh", "zero_stage"),
 }
 
 

@@ -148,6 +148,37 @@ def init_train_state(key, cfg: TrainConfig) -> Pytree:
 
 
 @dataclasses.dataclass(frozen=True)
+class ZeroHooks:
+    """ZeRO-2/3 layout hooks (ISSUE 13, arXiv:2004.13336): the three points
+    where state sharding changes the weight-update computation's layout,
+    injected by the parallel backends so the step bodies stay
+    layout-agnostic. Every callable takes (tree, net) with net in
+    {"gen", "disc"} (the EMA mirror rides the "gen" specs). With no hooks
+    (zero_stage=1, the default) the step is bit-identical to the pre-ZeRO
+    program — the parity contract the committed jaxpr fingerprints pin.
+
+    reduce_grads: full per-replica gradient tree -> the optimizer's input.
+        Replaces the gradient `_pmean` at EVERY site: gspmd constrains the
+        grads to the rule engine's ZeRO grad specs (the partitioner lowers
+        the cross-replica sum as a reduce-scatter); shard_map writes the
+        `lax.psum_scatter` mean per sharded leaf explicitly (pmean for
+        leaves the policy leaves replicated). The result shards exactly
+        like the mu/nu moments, so Adam runs shard-local.
+    gather_updates: the shard-local Adam update tree -> the resident
+        params' layout. Stage 2: the ONE fused all-gather per update that
+        rebuilds replicated params; stage 3: identity (params stay
+        resident sharded).
+    gather_params: resident params -> the full view a forward/grad needs.
+        Stage 3's just-in-time all-gather (gspmd: a replication
+        constraint, shard_map: explicit `lax.all_gather`); identity at
+        stage 2, where params are already full between steps.
+    """
+    reduce_grads: Callable
+    gather_updates: Callable
+    gather_params: Callable
+
+
+@dataclasses.dataclass(frozen=True)
 class TrainStepFns:
     """Bundle of the compiled-surface functions for one TrainConfig."""
     train_step: Callable  # (state, images, key[, labels]) -> (state, metrics)
@@ -184,7 +215,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                     constrain_fake: Optional[Callable] = None,
                     constrain_micro: Optional[Callable] = None,
                     attn_mesh=None, pallas_mesh=None,
-                    local_batch: Optional[int] = None) -> TrainStepFns:
+                    local_batch: Optional[int] = None,
+                    zero_hooks: Optional[ZeroHooks] = None) -> TrainStepFns:
     """constrain_fake, if given, is applied to every generator output that is
     fed to the discriminator during training. The parallel layer passes a
     `with_sharding_constraint` to the real-image sharding here when the mesh
@@ -207,6 +239,12 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
     cfg.batch_size (the global batch — correct under jit-with-sharding,
     where programs see global shapes); the shard_map backend passes its
     per-device batch instead, since each shard's program sees local shapes.
+
+    zero_hooks (ISSUE 13): the ZeroHooks bundle a backend passes under
+    zero_stage >= 2. None (the default) keeps every code path bit-identical
+    to the pre-ZeRO step — the hooks' identity/default forms below ARE the
+    original call sites, so the committed program fingerprints only move
+    when the knob does.
     """
     mcfg = cfg.model
     opt_g = make_optimizer(cfg, cfg.g_learning_rate)   # TTUR-capable:
@@ -236,6 +274,32 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
     def _pmean(x):
         return lax.pmean(x, axis_name) if axis_name is not None else x
 
+    # --- ZeRO layout hooks (ISSUE 13): resolved ONCE here so every
+    # gradient/update/forward site below reads layout-agnostic names. The
+    # defaults reproduce the pre-ZeRO program exactly: reduce_grads is the
+    # gradient _pmean, the two gathers are python identity (same tracer
+    # out, no jaxpr change).
+    if zero_hooks is None:
+        def _reduce_grads(g, net):
+            return _pmean(g)
+
+        def _gather_updates(u, net):
+            return u
+
+        def _gather_params(p, net):
+            return p
+    else:
+        _reduce_grads = zero_hooks.reduce_grads
+        _gather_updates = zero_hooks.gather_updates
+        _gather_params = zero_hooks.gather_params
+
+    def _opt_arg(p):
+        # optax.update's `params` argument: our chain (clip + adam) never
+        # reads it, but under ZeRO the grads are SHARDS while the resident
+        # params may be full (stage 2) — pass None rather than a
+        # shape-mismatched tree a future transform might consume
+        return None if zero_hooks is not None else p
+
     # --- grad_accum microbatch helpers, shared by the fused accum step and
     # the pipelined stage bodies (ISSUE 7) so the accumulate-in-f32 /
     # average-then-pmean semantics are single-sourced ----------------------
@@ -257,9 +321,10 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         return jax.tree_util.tree_map(
             lambda a, g: a + g.astype(jnp.float32), acc, grads)
 
-    def _avg(acc, like):
-        return _pmean(jax.tree_util.tree_map(
-            lambda a, p: (a / cfg.grad_accum).astype(p.dtype), acc, like))
+    def _avg(acc, like, net):
+        return _reduce_grads(jax.tree_util.tree_map(
+            lambda a, p: (a / cfg.grad_accum).astype(p.dtype), acc, like),
+            net)
 
     def _critic_streams(iter_key, batch):
         """Per-critic-iteration randomness: fresh z against the same real
@@ -436,6 +501,9 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         """
         K = cfg.grad_accum
         params, bn = state["params"], state["bn"]
+        # ZeRO-3: the resident (possibly data-sharded) trees stay the
+        # update targets; forwards and grads run on the gathered full view
+        gen_full = _gather_params(params["gen"], "gen")
 
         imgs_s = _split_micro(images)
         lbls_s = _split_micro(labels) if labels is not None else None
@@ -453,23 +521,27 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         # --- D: each Adam apply from K accumulated microbatch grads ---------
         def d_accum_update(d_params, d_opt_state, bn_d_start, xs):
             """Scan K microbatches at fixed d_params, apply Adam once."""
+            d_full = _gather_params(d_params, "disc")
+
             def d_micro(carry, x):
                 g_acc, bn_d = carry
                 bn_in = {"gen": bn["gen"], "disc": bn_d}
                 (d_loss, (d_bn_i, d_real, d_fake, gp)), grads = \
                     jax.value_and_grad(d_loss_fn, has_aux=True)(
-                        d_params, params["gen"], bn_in, x["img"], x["z"],
+                        d_full, gen_full, bn_in, x["img"], x["z"],
                         x["gpk"], x.get("lbl"), state["step"], False,
                         x.get("augk"))
                 return ((_acc(g_acc, grads), d_bn_i),
                         (d_loss, d_real, d_fake, gp))
 
             (g_acc, bn_d), ms = lax.scan(
-                d_micro, (_zeros_f32(d_params), bn_d_start), xs)
+                d_micro, (_zeros_f32(d_full), bn_d_start), xs)
             updates, d_opt_state = opt_d.update(
-                _avg(g_acc, d_params), d_opt_state, d_params)
-            return (optax.apply_updates(d_params, updates), d_opt_state,
-                    bn_d, tuple(m.mean() for m in ms))
+                _avg(g_acc, d_full, "disc"), d_opt_state,
+                _opt_arg(d_params))
+            return (optax.apply_updates(
+                        d_params, _gather_updates(updates, "disc")),
+                    d_opt_state, bn_d, tuple(m.mean() for m in ms))
 
         if cfg.n_critic == 1:
             new_disc, d_opt, d_bn, (d_loss, d_real, d_fake, gp) = \
@@ -495,9 +567,11 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                 jax.random.split(gp_key, cfg.n_critic))
 
         if cfg.update_mode == "sequential":
-            g_target_disc, disc_bn_for_g = new_disc, d_bn
+            g_target_disc, disc_bn_for_g = \
+                _gather_params(new_disc, "disc"), d_bn
         else:  # "fused": G grads at pre-update D params (reference parity)
-            g_target_disc, disc_bn_for_g = params["disc"], bn["disc"]
+            g_target_disc, disc_bn_for_g = \
+                _gather_params(params["disc"], "disc"), bn["disc"]
 
         # --- G: same accumulation against the (possibly updated) D ----------
         # the top-level z/aug streams, like the non-accum G step (with
@@ -509,16 +583,17 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             bn_in = {"gen": bn_g, "disc": disc_bn_for_g}
             (g_loss, (g_bn_i,)), grads = \
                 jax.value_and_grad(g_loss_fn, has_aux=True)(
-                    params["gen"], g_target_disc, bn_in, x["z"],
+                    gen_full, g_target_disc, bn_in, x["z"],
                     x.get("lbl"), x.get("augk"))
             return (_acc(g_acc, grads), g_bn_i), g_loss
 
         (g_gacc, g_bn), g_losses = lax.scan(
-            g_micro, (_zeros_f32(params["gen"]), bn["gen"]), g_xs)
-        g_grads = _avg(g_gacc, params["gen"])
+            g_micro, (_zeros_f32(gen_full), bn["gen"]), g_xs)
+        g_grads = _avg(g_gacc, gen_full, "gen")
         g_updates, g_opt = opt_g.update(g_grads, state["opt"]["gen"],
-                                        params["gen"])
-        new_gen = optax.apply_updates(params["gen"], g_updates)
+                                        _opt_arg(params["gen"]))
+        new_gen = optax.apply_updates(params["gen"],
+                                      _gather_updates(g_updates, "gen"))
 
         new_state = {
             "params": {"gen": new_gen, "disc": new_disc},
@@ -551,17 +626,23 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                                      labels)
 
         params, bn = state["params"], state["bn"]
+        # ZeRO-3: resident (possibly data-sharded) trees are the update
+        # targets; forwards and grads run on the gathered full view
+        gen_full = _gather_params(params["gen"], "gen")
 
         # --- D step(s) ------------------------------------------------------
         if cfg.n_critic == 1:
             (d_loss, (d_bn, d_real, d_fake, gp)), d_grads = jax.value_and_grad(
                 d_loss_fn, has_aux=True)(
-                    params["disc"], params["gen"], bn, images, z, gp_key,
+                    _gather_params(params["disc"], "disc"), gen_full, bn,
+                    images, z, gp_key,
                     labels, state["step"], False, aug_key)
-            d_grads = _pmean(d_grads)
+            d_grads = _reduce_grads(d_grads, "disc")
             d_updates, d_opt = opt_d.update(d_grads, state["opt"]["disc"],
-                                            params["disc"])
-            new_disc = optax.apply_updates(params["disc"], d_updates)
+                                            _opt_arg(params["disc"]))
+            new_disc = optax.apply_updates(params["disc"],
+                                           _gather_updates(d_updates,
+                                                           "disc"))
         else:
             # n_critic > 1 (canonical WGAN-GP: 5) — scanned critic updates
             # inside the same compiled program. Each iteration draws fresh z
@@ -573,11 +654,14 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                 bn_in = {"gen": bn["gen"], "disc": d_bn_c}
                 (loss_i, (bn_i, real_i, fake_i, gp_i)), grads = \
                     jax.value_and_grad(d_loss_fn, has_aux=True)(
-                        d_params_c, params["gen"], bn_in, images, z_i, gpk,
+                        _gather_params(d_params_c, "disc"), gen_full,
+                        bn_in, images, z_i, gpk,
                         labels, state["step"], False, aug_k)
-                grads = _pmean(grads)
-                updates, d_opt_c = opt_d.update(grads, d_opt_c, d_params_c)
-                d_params_c = optax.apply_updates(d_params_c, updates)
+                grads = _reduce_grads(grads, "disc")
+                updates, d_opt_c = opt_d.update(grads, d_opt_c,
+                                                _opt_arg(d_params_c))
+                d_params_c = optax.apply_updates(
+                    d_params_c, _gather_updates(updates, "disc"))
                 # last iteration's metrics ride the carry; note they are
                 # evaluated at that iteration's PRE-update params (one Adam
                 # step stale relative to the critic G trains against)
@@ -594,20 +678,21 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                 iter_keys)
 
         if cfg.update_mode == "sequential":
-            g_target_disc = new_disc
+            g_target_disc = _gather_params(new_disc, "disc")
             g_bn_in = {"gen": bn["gen"], "disc": d_bn}
         else:  # "fused": reference parity — G grads at pre-update D params
-            g_target_disc = params["disc"]
+            g_target_disc = _gather_params(params["disc"], "disc")
             g_bn_in = bn
 
         # --- G step ---------------------------------------------------------
         (g_loss, (g_bn,)), g_grads = jax.value_and_grad(
             g_loss_fn, has_aux=True)(
-                params["gen"], g_target_disc, g_bn_in, z, labels, aug_key)
-        g_grads = _pmean(g_grads)
+                gen_full, g_target_disc, g_bn_in, z, labels, aug_key)
+        g_grads = _reduce_grads(g_grads, "gen")
         g_updates, g_opt = opt_g.update(g_grads, state["opt"]["gen"],
-                                        params["gen"])
-        new_gen = optax.apply_updates(params["gen"], g_updates)
+                                        _opt_arg(params["gen"]))
+        new_gen = optax.apply_updates(params["gen"],
+                                      _gather_updates(g_updates, "gen"))
 
         new_state = {
             "params": {"gen": new_gen, "disc": new_disc},
@@ -668,7 +753,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         """The FILL program: an [n_critic, B, ...] fake stack from the
         CURRENT generator — dispatched at run start, after a restore, and
         after a rollback invalidated the in-flight buffer."""
-        return _fake_stack(state["params"]["gen"], state["bn"]["gen"],
+        return _fake_stack(_gather_params(state["params"]["gen"], "gen"),
+                           state["bn"]["gen"],
                            jax.random.fold_in(key, _FILL_TAG),
                            cfg.n_critic)
 
@@ -693,6 +779,7 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             def critic_iter(carry, xs):
                 d_params_c, d_opt_c, d_bn_c, _ = carry
                 fake_i, iter_key = xs
+                d_full = _gather_params(d_params_c, "disc")
                 _, gpk, aug_k = _critic_streams(iter_key, stage_batch)
                 xs_m = {"img": imgs_s, "fake": _split_micro(fake_i),
                         "gpk": jax.random.split(gpk, cfg.grad_accum)}
@@ -704,17 +791,19 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                     bn_in = {"gen": bn["gen"], "disc": bn_d}
                     (loss, (bn_i, real, fk, gp)), grads = \
                         jax.value_and_grad(_d_loss_on_fake, has_aux=True)(
-                            d_params_c, bn_in, x["img"], x["fake"],
+                            d_full, bn_in, x["img"], x["fake"],
                             x["gpk"], None, state["step"], False,
                             x.get("augk"))
                     return ((_acc(g_acc, grads), bn_i),
                             (loss, real, fk, gp))
 
                 (g_acc, bn_d), ms = lax.scan(
-                    d_micro, (_zeros_f32(d_params_c), d_bn_c), xs_m)
+                    d_micro, (_zeros_f32(d_full), d_bn_c), xs_m)
                 updates, d_opt_c = opt_d.update(
-                    _avg(g_acc, d_params_c), d_opt_c, d_params_c)
-                return ((optax.apply_updates(d_params_c, updates),
+                    _avg(g_acc, d_full, "disc"), d_opt_c,
+                    _opt_arg(d_params_c))
+                return ((optax.apply_updates(
+                             d_params_c, _gather_updates(updates, "disc")),
                          d_opt_c, bn_d, tuple(m.mean() for m in ms)), None)
         else:
             def critic_iter(carry, xs):
@@ -724,11 +813,14 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                 bn_in = {"gen": bn["gen"], "disc": d_bn_c}
                 (loss_i, (bn_i, real_i, fake_m, gp_i)), grads = \
                     jax.value_and_grad(_d_loss_on_fake, has_aux=True)(
-                        d_params_c, bn_in, images, fake_i, gpk, None,
+                        _gather_params(d_params_c, "disc"), bn_in, images,
+                        fake_i, gpk, None,
                         state["step"], False, aug_k)
-                grads = _pmean(grads)
-                updates, d_opt_c = opt_d.update(grads, d_opt_c, d_params_c)
-                return ((optax.apply_updates(d_params_c, updates),
+                grads = _reduce_grads(grads, "disc")
+                updates, d_opt_c = opt_d.update(grads, d_opt_c,
+                                                _opt_arg(d_params_c))
+                return ((optax.apply_updates(
+                             d_params_c, _gather_updates(updates, "disc")),
                          d_opt_c, bn_i,
                          (loss_i, real_i, fake_m, gp_i)), None)
 
@@ -773,6 +865,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
             z_key, extra_key = jax.random.split(key)
             aug_key = None
         params, bn = state["params"], state["bn"]
+        gen_full = _gather_params(params["gen"], "gen")
+        disc_full = _gather_params(params["disc"], "disc")
 
         if cfg.grad_accum > 1:
             z = jax.random.uniform(z_key, (stage_batch, mcfg.z_dim),
@@ -787,13 +881,13 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                 bn_in = {"gen": bn_g, "disc": bn["disc"]}
                 (g_loss_i, (g_bn_i, fake_i)), grads = \
                     jax.value_and_grad(g_loss_fn, has_aux=True)(
-                        params["gen"], params["disc"], bn_in, x["z"],
+                        gen_full, disc_full, bn_in, x["z"],
                         None, x.get("augk"), return_fake=True)
                 return (_acc(g_acc, grads), g_bn_i), (g_loss_i, fake_i)
 
             (g_gacc, g_bn), (g_losses, fakes_m) = lax.scan(
-                g_micro, (_zeros_f32(params["gen"]), bn["gen"]), xs)
-            g_grads = _avg(g_gacc, params["gen"])
+                g_micro, (_zeros_f32(gen_full), bn["gen"]), xs)
+            g_grads = _avg(g_gacc, gen_full, "gen")
             g_loss = g_losses.mean()
             # (K, micro, ...) -> (B, ...): the full-batch fake the next
             # d_update re-splits into its own microbatches
@@ -804,15 +898,16 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
                                    dtype=jnp.float32)
             (g_loss, (g_bn, fake)), g_grads = jax.value_and_grad(
                 g_loss_fn, has_aux=True)(
-                    params["gen"], params["disc"], bn, z, None, aug_key,
+                    gen_full, disc_full, bn, z, None, aug_key,
                     return_fake=True)
-            g_grads = _pmean(g_grads)
+            g_grads = _reduce_grads(g_grads, "gen")
         g_updates, g_opt = opt_g.update(g_grads, state["opt"]["gen"],
-                                        params["gen"])
-        new_gen = optax.apply_updates(params["gen"], g_updates)
+                                        _opt_arg(params["gen"]))
+        new_gen = optax.apply_updates(params["gen"],
+                                      _gather_updates(g_updates, "gen"))
 
         if cfg.n_critic > 1:
-            extra = _fake_stack(params["gen"], bn["gen"], extra_key,
+            extra = _fake_stack(gen_full, bn["gen"], extra_key,
                                 cfg.n_critic - 1)
             fakes = jnp.concatenate([fake[None], extra], axis=0)
         else:
@@ -835,6 +930,9 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         # a by-construction mirror and live weights are the clearer choice.
         g_params = (state["ema_gen"] if cfg.g_ema_decay > 0.0
                     else state["params"]["gen"])
+        # ZeRO-3: the EMA mirror shards like the live G params — one
+        # just-in-time gather serves both sources
+        g_params = _gather_params(g_params, "gen")
         return sampler_apply(g_params, state["bn"]["gen"], z,
                              cfg=mcfg, labels=labels,
                              pallas_mesh=pallas_mesh)
@@ -852,6 +950,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         from dcgan_tpu.utils.metrics import activation_stats
 
         params, bn = state["params"], state["bn"]
+        params = {"gen": _gather_params(params["gen"], "gen"),
+                  "disc": _gather_params(params["disc"], "disc")}
         z = jax.random.uniform(key, (images.shape[0], mcfg.z_dim),
                                minval=-1.0, maxval=1.0, dtype=jnp.float32)
         g_cap: dict = {}
@@ -888,6 +988,8 @@ def make_train_step(cfg: TrainConfig, *, axis_name: Optional[str] = None,
         discarded. WGAN-GP's interpolation uses a fixed key: a deterministic
         probe, not a training signal."""
         params, bn = state["params"], state["bn"]
+        params = {"gen": _gather_params(params["gen"], "gen"),
+                  "disc": _gather_params(params["disc"], "disc")}
         gp_key = jax.random.key(0)
         d_loss, (_, d_real, d_fake, gp) = d_loss_fn(
             params["disc"], params["gen"], bn, images, z, gp_key, labels,

@@ -1053,6 +1053,21 @@ class TestSpecCoverage:
         assert any(f.check == "DCG011" and "spec-ambiguous" in f.key
                    and "proj/w" in f.key for f in fs)
 
+    def test_prefix_keyed_rule_reports_grad_spec_drift(self, monkeypatch):
+        """ISSUE 13: a rule row that keys on the mu/ prefix makes the
+        moment resolve differently from the bare-tail GRADIENT spec —
+        the reduce-scattered gradient and the shard-local Adam state
+        would disagree on layout under zero_stage >= 2, which the
+        grad-spec derivation audit must surface."""
+        from dcgan_tpu.elastic import rules as rmod
+
+        keyed = ((r"(^|/)mu/proj/w$", rmod.REPLICATED),) \
+            + rmod.PARTITION_RULES
+        monkeypatch.setattr(rmod, "PARTITION_RULES", keyed)
+        fs = semantic.check_spec_coverage()
+        assert any(f.check == "DCG011" and "grad-spec-drift" in f.key
+                   and "proj/w" in f.key for f in fs)
+
     def test_dcg011_redirected_from_ast_driver(self):
         with pytest.raises(ValueError, match="--semantic"):
             run({"dcgan_tpu/x.py": "x = 1\n"}, checks=["DCG011"])

@@ -42,6 +42,13 @@ never silently trains garbage, never hangs.
                                                          restored state, run
                                                          completes; replay is
                                                          bit-exact
+    zero-rollback         NaN mid-run under              sharded snapshot
+                          --zero_stage 3 (shard_map,     restores, run
+                          2 virtual devices)             completes; losses +
+                                                         STATE_SUM replay
+                                                         BIT-EXACT vs a
+                                                         --zero_stage 1
+                                                         control (ISSUE 13)
     thread-checks         (no fault) DCGAN_THREAD_       tripwire arms, wraps
                           CHECKS=1 runtime tripwire      every collective
                                                          entry point, run
@@ -110,6 +117,11 @@ import tempfile
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# jax-free import (config never touches jax at module scope): the
+# zero-rollback scenario passes a MeshConfig through the driver's
+# repr-round-tripped `extra` dict
+from dcgan_tpu.config import MeshConfig  # noqa: E402
+
 # CI subset (tests/test_tools.py pins --smoke into tier-1): the cheapest
 # scenarios that still cross every new layer — quarantine (data), retry
 # (checkpoint IO), worker-crash surfacing (services). The two-phase
@@ -126,7 +138,7 @@ if os.environ.get("DRILL_THREEFRY_PARTITIONABLE"):
     # threefry (testing/multihost.py) — the flag changes the generated
     # random STREAM, so both layouts must agree on it
     jax.config.update("jax_threefry_partitionable", True)
-from dcgan_tpu.config import ModelConfig, TrainConfig
+from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from dcgan_tpu.train.trainer import train
 base = dict(batch_size=8, tensorboard=False, sample_every_steps=0,
             save_summaries_secs=0.0, log_every_steps=1)
@@ -452,6 +464,55 @@ def scenario_pipeline_rollback(root: str) -> dict:
             "replay_bit_exact": True}
 
 
+def scenario_zero_rollback(root: str) -> dict:
+    """NaN mid-run under --zero_stage 3 (ISSUE 13): the anomaly rollback
+    snapshots and restores the data-SHARDED state (params, EMA, and both
+    Adam moments live as rule-engine shards between steps), training
+    completes, and the post-rollback losses AND final STATE_SUM replay
+    BIT-EXACT against a --zero_stage 1 control fed the same fault — the
+    state sharding is a layout, not a different trajectory. backend=
+    shard_map: its explicit psum_scatter/all_gather round trip reproduces
+    the stage-1 pmean arithmetic to the last bit on CPU (the gspmd
+    partitioner reassociates reductions, so stage parity there is
+    tolerance-level — tests/test_zero.py). Both arms run single-process
+    over 2 virtual devices, the 2-way data axis stage 3 needs."""
+    env = {"XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+           "DRILL_THREEFRY_PARTITIONABLE": "1"}
+    knobs = dict(backend="shard_map", nan_policy="rollback",
+                 nan_check_steps=1, rollback_snapshot_steps=2,
+                 max_rollbacks=2, save_model_secs=1e9,
+                 save_summaries_secs=0.0)
+
+    def one(tag, stage):
+        ck = os.path.join(root, f"ck-{tag}")
+        rc, out = _run_train(
+            dict(checkpoint_dir=ck,
+                 sample_dir=os.path.join(root, f"sm-{tag}"),
+                 mesh=MeshConfig(zero_stage=stage), **knobs),
+            max_steps=6, chaos={"nan_at_step": 3}, env_extra=env)
+        _check(rc == 0, f"{tag}: trainer failed (rc={rc}): {out[-800:]}")
+        _check("rolling back to last-good snapshot at step 2" in out,
+               f"{tag}: no rollback message: {out[-800:]}")
+        _check("TRAIN_DONE step=6" in out,
+               f"{tag}: run did not complete: {out[-400:]}")
+        rollbacks = _scalar_values(_events(ck), "anomaly/rollbacks")
+        _check(rollbacks and max(rollbacks) >= 1,
+               f"{tag}: anomaly/rollbacks missing (got {rollbacks})")
+        return _state_sum(out), _loss_rows(_events(ck)), max(rollbacks)
+
+    sum_z, loss_z, rollbacks = one("zero3", 3)
+    sum_c, loss_c, _ = one("zero1", 1)
+    for s in sorted(loss_c):
+        _check(loss_z.get(s) == loss_c[s],
+               f"step-{s} losses diverged across zero stages: "
+               f"{loss_z.get(s)} != {loss_c[s]}")
+    _check(sum_z == sum_c,
+           f"zero_stage=3 rollback state diverged from the stage-1 "
+           f"control: {sum_z} != {sum_c}")
+    return {"rollbacks": rollbacks, "final_step": 6,
+            "replay_bit_exact": True, "state_sum": sum_z}
+
+
 def scenario_thread_checks(root: str) -> dict:
     """(no fault) a short train under DCGAN_THREAD_CHECKS=1 (ISSUE 8): the
     runtime thread-discipline tripwire wraps every collective entry point
@@ -549,6 +610,7 @@ SCENARIOS = {
     "serve-drain": scenario_serve_drain,
     "thread-checks": scenario_thread_checks,
     "pipeline-rollback": scenario_pipeline_rollback,
+    "zero-rollback": scenario_zero_rollback,
     "corrupt-record": scenario_corrupt_record,
     "corrupt-budget": scenario_corrupt_budget,
     "truncate-checkpoint": scenario_truncate_checkpoint,
@@ -585,7 +647,7 @@ jax.distributed.initialize(
     num_processes=int(os.environ["MH_NPROC"]),
     process_id=int(os.environ["MH_PID"]))
 import numpy as np
-from dcgan_tpu.config import ModelConfig, TrainConfig
+from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from dcgan_tpu.train.trainer import train
 base = dict(batch_size=8, tensorboard=False, sample_every_steps=0,
             activation_summary_steps=0, save_summaries_secs=1e9,
