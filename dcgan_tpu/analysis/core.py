@@ -23,9 +23,11 @@ from __future__ import annotations
 
 import ast
 import dataclasses
+import io
 import json
 import os
 import re
+import tokenize
 from typing import Dict, List, Optional, Sequence, Tuple
 
 _SUPPRESS_RE = re.compile(r"#\s*dcg:\s*disable=([A-Za-z0-9_,\s]+)")
@@ -65,13 +67,24 @@ class SourceFile:
         # checker resolves cross-module imports through it
         self.module = self.path[:-3].replace("/", ".") \
             if self.path.endswith(".py") else self.path.replace("/", ".")
+        # suppressions come from real COMMENT tokens only (ISSUE 14): the
+        # old per-line regex also matched `# dcg: disable=...` mentions
+        # inside docstrings, which both created phantom suppressions and
+        # would have made the stale-suppression audit (DCG014) flag prose
         self.suppressed: Dict[int, set] = {}
-        for i, line in enumerate(source.splitlines(), start=1):
-            m = _SUPPRESS_RE.search(line)
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(source).readline))
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            tokens = []
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
             if m:
                 ids = {t.strip().upper() for t in m.group(1).split(",")
                        if t.strip()}
-                self.suppressed[i] = ids
+                self.suppressed[tok.start[0]] = ids
         self.parents: Dict[ast.AST, ast.AST] = {}
         for node in ast.walk(self.tree):
             for child in ast.iter_child_nodes(node):
@@ -136,6 +149,10 @@ class Config:
     # exemption is declared, not assumed. Format: "path::QualName".
     dispatch_thread_targets: Tuple[str, ...] = (
         "dcgan_tpu/serve/worker.py::ServeWorker._run",
+        # each protocol-simulator thread IS the dispatch thread of its
+        # virtual process (ISSUE 14) — it drives the real coordination
+        # transports through rendezvous shims by design
+        "dcgan_tpu/analysis/simulate.py::_virtual_process_main",
     )
     # DCG006: modules whose mutating filesystem calls must be retried
     # (utils/retry.retry_io) or explicitly fenced with try/except OSError
@@ -146,6 +163,17 @@ class Config:
     )
     # DCG003: the one file allowed to name jax's shard_map directly
     shard_map_exempt: Tuple[str, ...] = ("dcgan_tpu/utils/backend.py",)
+    # DCG013: modules that participate in the multi-host lockstep
+    # protocol — the divergence lint only makes sense where N processes
+    # must issue identical collective streams (the serving plane is a
+    # single-process surface by design and stays out)
+    protocol_modules: Tuple[str, ...] = (
+        "dcgan_tpu/train/",
+        "dcgan_tpu/utils/checkpoint.py",
+        "dcgan_tpu/elastic/",
+        "dcgan_tpu/parallel/",
+        "dcgan_tpu/evals/",
+    )
 
     def load_inventory(self) -> Dict[str, str]:
         if self.inventory is not None:
@@ -264,6 +292,7 @@ def load_baseline(path: str) -> List[Dict[str, str]]:
                     f"{path}:{i}: baseline entry for {obj['key']!r} still "
                     "carries the draft 'TODO' justification — replace it "
                     "with the real reason before committing")
+            obj["_line"] = i  # stale-audit/prune anchor (never written)
             entries.append(obj)
     return entries
 
@@ -295,10 +324,16 @@ def split_baselined(findings: Sequence[Finding],
 # -- driver ------------------------------------------------------------------
 
 def run_checks(sources: Sequence[SourceFile], config: Optional[Config] = None,
-               checks: Optional[Sequence[str]] = None) -> List[Finding]:
+               checks: Optional[Sequence[str]] = None,
+               suppressed_out: Optional[List[Finding]] = None
+               ) -> List[Finding]:
     """Run the requested checkers (default: all) over the parsed sources;
-    per-line `# dcg: disable=` suppressions are already applied."""
-    from dcgan_tpu.analysis import donation, hygiene, parity, threads
+    per-line `# dcg: disable=` suppressions are already applied. Pass
+    `suppressed_out` to receive the findings a suppression absorbed —
+    the stale-suppression audit (DCG014) needs them to tell a working
+    suppression from a dead one."""
+    from dcgan_tpu.analysis import donation, hygiene, parity, protocol, \
+        threads
 
     registry = {
         "DCG001": threads.check_collectives_off_dispatch,
@@ -307,12 +342,14 @@ def run_checks(sources: Sequence[SourceFile], config: Optional[Config] = None,
         "DCG004": parity.check_key_inventory,
         "DCG005": hygiene.check_traced_body_hygiene,
         "DCG006": hygiene.check_bare_io,
+        "DCG013": protocol.check_divergent_branch,
     }
     config = config or Config()
     if checks:
         checks = [c.upper() for c in checks]
         unknown = sorted(set(checks) - set(registry))
         if unknown:
+            from dcgan_tpu.analysis.protocol import PROTOCOL_CHECKS
             from dcgan_tpu.analysis.semantic import SEMANTIC_CHECKS
 
             if set(unknown) <= set(SEMANTIC_CHECKS):
@@ -320,19 +357,115 @@ def run_checks(sources: Sequence[SourceFile], config: Optional[Config] = None,
                     f"{unknown} are semantic-tier check ID(s) — run "
                     "`python -m dcgan_tpu.analysis --semantic --checks "
                     + " ".join(unknown) + "`")
+            if set(unknown) <= set(PROTOCOL_CHECKS):
+                raise ValueError(
+                    f"{unknown} are protocol-tier check ID(s) — run "
+                    "`python -m dcgan_tpu.analysis --protocol`")
             raise ValueError(
                 f"unknown check ID(s) {unknown}; valid: {sorted(registry)}"
-                f" (AST tier) + {list(SEMANTIC_CHECKS)} (--semantic)")
+                f" (AST tier) + {list(SEMANTIC_CHECKS)} (--semantic) + "
+                f"{list(PROTOCOL_CHECKS)} (--protocol)")
     by_path = {sf.path: sf for sf in sources}
     findings: List[Finding] = []
     for check_id in checks or sorted(registry):
         for f in registry[check_id](list(sources), config):
             sf = by_path.get(f.path)
             if sf is not None and sf.is_suppressed(f):
+                if suppressed_out is not None:
+                    suppressed_out.append(f)
                 continue
             findings.append(f)
     findings.sort(key=lambda f: (f.path, f.line, f.check))
     return findings
+
+
+AST_CHECK_IDS = ("DCG001", "DCG002", "DCG003", "DCG004", "DCG005",
+                 "DCG006", "DCG013")
+
+STALE_SUPPRESSION_CHECK = "DCG014"
+STALE_BASELINE_CHECK = "DCG015"
+
+
+def audit_stale_suppressions(sources: Sequence[SourceFile],
+                             suppressed: Sequence[Finding]
+                             ) -> List[Finding]:
+    """DCG014: `# dcg: disable=DCGxxx` comments that suppress no current
+    finding are findings themselves — a dead suppression is an exemption
+    with no exempted violation, and it would silently swallow the NEXT
+    real finding landing on its line. Only sound after a FULL AST run
+    (the drivers skip it under `--checks` subsets); IDs belonging to the
+    semantic/protocol tiers can never match a line suppression (those
+    findings have no source line) and are therefore always stale."""
+    used = {(f.path, f.line, f.check) for f in suppressed}
+    findings: List[Finding] = []
+    for sf in sources:
+        for line, ids in sorted(sf.suppressed.items()):
+            for check_id in sorted(ids):
+                if (sf.path, line, check_id) in used:
+                    continue
+                findings.append(Finding(
+                    check=STALE_SUPPRESSION_CHECK, path=sf.path, line=line,
+                    symbol="<suppression>", key=check_id,
+                    message=(f"suppression `# dcg: disable={check_id}` "
+                             "matches no current finding on this line — "
+                             "delete it (a dead suppression would "
+                             "silently swallow the next real finding "
+                             "here)")))
+    return findings
+
+
+def audit_stale_baseline(entries: Sequence[Dict[str, str]],
+                         consumed: Sequence[Finding],
+                         ran_checks: Sequence[str],
+                         baseline_rel_path: str
+                         ) -> Tuple[List[Finding], List[Dict[str, str]]]:
+    """DCG015: baseline rows whose fingerprint no longer matches any
+    finding of a check that RAN this invocation. Returns (findings,
+    stale entries) — `--prune-baseline` rewrites the file minus the
+    latter. Rows of tiers that did not run are left alone (a per-tier
+    invocation must not call another tier's exemptions dead). Stale-audit
+    findings are deliberately NOT baselinable — the fix is deleting the
+    row, never exempting the exemption."""
+    import collections
+
+    ran = set(ran_checks)
+    budget = collections.Counter(f.fingerprint() for f in consumed)
+    findings: List[Finding] = []
+    stale: List[Dict[str, str]] = []
+    for e in entries:
+        if e["check"] not in ran:
+            continue
+        fp = (e["check"], e["path"], e["symbol"], e["key"])
+        if budget[fp] > 0:
+            budget[fp] -= 1
+            continue
+        stale.append(e)
+        findings.append(Finding(
+            check=STALE_BASELINE_CHECK, path=baseline_rel_path,
+            line=int(e.get("_line", 0)), symbol=e["symbol"],
+            key=f"{e['check']}:{e['key']}",
+            message=(f"baseline row ({e['check']}, {e['path']}, "
+                     f"{e['symbol']}, {e['key']}) matches no current "
+                     "finding — the exemption is dead; delete the row "
+                     "or run --prune-baseline")))
+    return findings, stale
+
+
+def prune_baseline_file(path: str,
+                        stale: Sequence[Dict[str, str]]) -> int:
+    """Rewrite the baseline minus the given stale rows (matched by their
+    load-time line numbers); comment/header lines survive. Returns the
+    number of rows dropped."""
+    dead_lines = {int(e["_line"]) for e in stale if "_line" in e}
+    if not dead_lines:
+        return 0
+    with open(path, encoding="utf-8") as f:
+        lines = f.readlines()
+    kept = [line for i, line in enumerate(lines, start=1)
+            if i not in dead_lines]
+    with open(path, "w", encoding="utf-8") as f:
+        f.writelines(kept)
+    return len(lines) - len(kept)
 
 
 def default_root() -> str:

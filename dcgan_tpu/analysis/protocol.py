@@ -1,0 +1,611 @@
+"""Protocol tier (ISSUE 14): DCG012 lockstep audit + DCG013 divergence lint.
+
+DCG012 — exhaustive lockstep audit of the multi-host coordination layer.
+`python -m dcgan_tpu.analysis --protocol` runs the simulator
+(analysis/simulate.py): N virtual processes through the REAL decision
+code over every (knob config x one-shot fault) interleaving, then audits
+
+- termination: no virtual process left blocked in a transport a peer
+  never enters (a deadlock under an armed watchdog resolves as a trip on
+  every blocked process — the job dies loudly, which counts as
+  terminating; a deadlock with no watchdog is a finding);
+- lockstep: every process's collective schedule (op + tag + cadence
+  position) is byte-identical — for watchdog interleavings, identical
+  across the surviving processes with the hung process a strict prefix;
+- drift: the canonical schedules are committed as
+  `analysis/protocol.lock.jsonl` (same contract as programs.lock.jsonl);
+  ANY difference between a fresh exploration and the committed lock is a
+  finding naming the regen command (`--protocol --write-lock`).
+
+DCG013 — static divergence lint (AST tier, import-free, runs with
+DCG001-006 in the default invocation). Within the multi-host protocol
+modules (`Config.protocol_modules`), any branch conditioned on
+host-local state — wall clock, `jax.process_index()`, a caught
+exception, a counter advanced inside an exception handler — that
+directly calls a collective sink (the DCG001 sink set: coordination
+transports, `pt.*` programs, Checkpointer collectives) is flagged: the
+branch can be taken on a strict subset of hosts, and a collective
+entered asymmetrically is the canonical SPMD deadlock. The blessed
+pattern is taint SANITIZATION: gather the local state first
+(`anomaly_consensus`, `stop.poll`, `process_allgather`, ...) and branch
+on the gathered — mesh-uniform — verdict; names assigned from a
+consensus call are never tainted. Function-local only (cross-function
+divergence is the simulator's job); attribute state is not tracked.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from dcgan_tpu.analysis.core import (
+    Config,
+    Finding,
+    SourceFile,
+    call_name,
+)
+from dcgan_tpu.analysis.threads import _is_sink
+
+PROTOCOL_CHECKS = ("DCG012",)
+LOCK_CHECK = "DCG012"
+DIVERGENCE_CHECK = "DCG013"
+
+#: repo-relative path DCG012 findings anchor on (the committed contract)
+LOCK_REL_PATH = "dcgan_tpu/analysis/protocol.lock.jsonl"
+
+_REGEN_CMD = "python -m dcgan_tpu.analysis --protocol --write-lock"
+
+_HEADER = (
+    "# Protocol lock (ISSUE 14): the canonical per-process collective",
+    "# schedules of the simulated coordination protocol — every explored",
+    "# (knob config x one-shot fault) interleaving, terminated and",
+    "# lockstep-audited (analysis/simulate.py). Regenerate deliberately:",
+    f"#   {_REGEN_CMD}",
+    "# Any drift between a fresh exploration and this file is a DCG012",
+    "# finding; review the diff like a contract change, because it is one.",
+)
+
+
+# -- DCG013: static divergence lint -------------------------------------------
+
+#: terminal callee names that read host-local state. Receiver-gated where
+#: the bare name is too generic ("now" is datetime-only, "time" must be
+#: the time module's).
+_TAINT_CALLS: Dict[str, Tuple[str, ...]] = {
+    # wall clock
+    "time": ("time",), "monotonic": ("time",), "perf_counter": ("time",),
+    "process_time": ("time",), "time_ns": ("time",),
+    "monotonic_ns": ("time",), "perf_counter_ns": ("time",),
+    "now": ("datetime",), "utcnow": ("datetime",),
+    # process identity
+    "process_index": ("jax", ""), "getpid": ("os", ""),
+    "gethostname": ("socket", ""), "uuid4": ("uuid", ""),
+}
+
+#: consensus calls whose RESULT is mesh-uniform: assignment from one of
+#: these sanitizes the target name (the blessed gather-then-branch shape)
+_SANITIZERS = frozenset({
+    "anomaly_consensus", "process_allgather", "_allgather_i32",
+    "_allgather_f32", "fleet_health_gather", "broadcast_one_to_all",
+})
+
+
+def _taint_call_reason(sf: SourceFile, call: ast.Call) -> Optional[str]:
+    name, receiver = call_name(call)
+    if name is None:
+        return None
+    gates = _TAINT_CALLS.get(name)
+    if gates is None:
+        return None
+    head = receiver.split(".")[-1] if receiver else ""
+    if head in gates:
+        return f"{receiver + '.' if receiver else ''}{name}()"
+    if "" in gates and not receiver:
+        return f"{name}()"
+    if not receiver:
+        imp = sf.from_imports.get(name)
+        if imp is not None and imp[0].split(".")[-1] in gates:
+            return f"{imp[0]}.{imp[1]}()"
+    return None
+
+
+def _is_sanitizer(call: ast.Call) -> bool:
+    name, receiver = call_name(call)
+    if name in _SANITIZERS:
+        return True
+    # stop.poll(): the coordinated-stop consensus — receiver-gated like
+    # the DCG001 table (`opt.poll` / `selector.poll` never match)
+    return name == "poll" and any("stop" in seg
+                                  for seg in receiver.split("."))
+
+
+def _expr_taint(sf: SourceFile, node: ast.AST,
+                tainted: Dict[str, str]) -> Optional[str]:
+    """Why `node`'s value is host-local, or None. A sanitizer call
+    anywhere in the expression wins: its result is mesh-uniform even
+    when its arguments were tainted. Tainted NAMES propagate only
+    outside call-argument position — `rollback.restore(e)`'s result is
+    not host-local just because a (consensus-symmetric) exception rode
+    in as an argument; flow THROUGH calls is the simulator's job, not
+    this lint's. Host-local SOURCE calls taint from anywhere."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call) and _is_sanitizer(n):
+            return None
+
+    def visit(n: ast.AST, in_call_args: bool) -> Optional[str]:
+        if isinstance(n, ast.Call):
+            reason = _taint_call_reason(sf, n)
+            if reason is not None:
+                return reason
+            for child in ast.iter_child_nodes(n):
+                r = visit(child, True)
+                if r is not None:
+                    return r
+            return None
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id in tainted and not in_call_args:
+            return tainted[n.id]
+        for child in ast.iter_child_nodes(n):
+            r = visit(child, in_call_args)
+            if r is not None:
+                return r
+        return None
+
+    return visit(node, False)
+
+
+def _function_taint(sf: SourceFile, fn: ast.AST) -> Dict[str, str]:
+    """name -> reason: direct host-local sources, names assigned from
+    tainted expressions, exception-handler bindings, and counters
+    advanced inside exception handlers. STRONG updates: an assignment
+    whose value is untainted — a sanitizing consensus call included —
+    KILLS the target's taint, so the blessed gather-then-branch shape
+    works even when it reuses the pre-gather name (`bad = local(); bad,
+    who = anomaly_consensus(bad)`). Events process in source order and
+    repeat to a bounded fixpoint so loop-carried chains resolve."""
+    events: List[Tuple[int, str, object]] = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.ExceptHandler):
+            if n.name:
+                events.append((n.lineno, "seed",
+                               (n.name,
+                                f"exception caught as {n.name!r}")))
+            for sub in ast.walk(n):
+                if isinstance(sub, ast.AugAssign) \
+                        and isinstance(sub.target, ast.Name):
+                    events.append((sub.lineno, "seed",
+                                   (sub.target.id,
+                                    f"counter {sub.target.id!r} advanced "
+                                    "in an exception handler")))
+        targets: List[ast.expr] = []
+        value: Optional[ast.AST] = None
+        if isinstance(n, ast.Assign):
+            targets, value = n.targets, n.value
+        elif isinstance(n, ast.AnnAssign) and n.value is not None:
+            targets, value = [n.target], n.value
+        elif isinstance(n, ast.NamedExpr):
+            targets, value = [n.target], n.value
+        if value is not None:
+            names = []
+            for t in targets:
+                names += [t.id] if isinstance(t, ast.Name) else [
+                    e.id for e in ast.walk(t) if isinstance(e, ast.Name)]
+            events.append((n.lineno, "assign", (tuple(names), value)))
+    events.sort(key=lambda e: e[0])
+
+    tainted: Dict[str, str] = {}
+    for _ in range(8):  # bounded fixpoint (loop-carried chains)
+        before = dict(tainted)
+        for _line, kind, payload in events:
+            if kind == "seed":
+                name, reason = payload
+                tainted[name] = reason
+                continue
+            names, value = payload
+            reason = _expr_taint(sf, value, tainted)
+            for nm in names:
+                if reason is not None:
+                    tainted[nm] = reason
+                else:
+                    # strong update: a mesh-uniform (or simply
+                    # host-global) value overwrites the host-local one
+                    tainted.pop(nm, None)
+        if tainted == before:
+            break
+    return tainted
+
+
+def _in_scope(path: str, config: Config) -> bool:
+    prefixes = getattr(config, "protocol_modules", ())
+    return any(path == p or path.startswith(p) for p in prefixes)
+
+
+def check_divergent_branch(sources: Sequence[SourceFile],
+                           config: Config) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for sf in sources:
+        if not _in_scope(sf.path, config):
+            continue
+        fns = [n for n in ast.walk(sf.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in fns:
+            tainted = _function_taint(sf, fn)
+            regions: List[Tuple[ast.AST, List[ast.AST], str]] = []
+            for n in ast.walk(fn):
+                if isinstance(n, (ast.If, ast.While)):
+                    reason = _expr_taint(sf, n.test, tainted)
+                    if reason is not None:
+                        regions.append(
+                            (n, list(n.body) + list(n.orelse),
+                             f"branch on host-local state ({reason})"))
+                elif isinstance(n, ast.IfExp):
+                    reason = _expr_taint(sf, n.test, tainted)
+                    if reason is not None:
+                        regions.append((n, [n.body, n.orelse],
+                                        "conditional expression on "
+                                        f"host-local state ({reason})"))
+                elif isinstance(n, ast.ExceptHandler):
+                    regions.append(
+                        (n, list(n.body),
+                         "exception handler (exceptions are host-local "
+                         "events)"))
+            for anchor, body, why in regions:
+                hit = _first_sink(body)
+                if hit is None:
+                    continue
+                call, sink = hit
+                dedup = (sf.path, call.lineno, sink)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                findings.append(Finding(
+                    check=DIVERGENCE_CHECK, path=sf.path, line=call.lineno,
+                    symbol=sf.enclosing_symbol(call), key=sink,
+                    message=(
+                        f"collective sink {sink!r} dominated by a {why}: "
+                        "a subset of hosts can enter this collective "
+                        "while the rest never do — the canonical SPMD "
+                        "deadlock (DESIGN.md §6c.1). Gather the local "
+                        "state first (anomaly_consensus / stop.poll / "
+                        "process_allgather) and branch on the "
+                        "mesh-uniform verdict")))
+    return findings
+
+
+def _first_sink(body: Sequence[ast.AST]
+                ) -> Optional[Tuple[ast.Call, str]]:
+    """First direct collective-sink call in the region, in source order
+    (one finding per region: past the first asymmetric collective the
+    mesh has already diverged — reporting the rest is noise). Nested
+    defs/lambdas are PRUNED as whole subtrees (manual recursion —
+    ast.walk cannot prune): code textually inside a region but only
+    DEFINED there runs elsewhere, e.g. a drain callback parked on
+    `rollback.on_restore` inside an except handler."""
+    def scan(n: ast.AST) -> Optional[Tuple[ast.Call, str]]:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return None
+        if isinstance(n, ast.Call):
+            name, receiver = call_name(n)
+            sink = _is_sink(name, receiver)
+            if sink is not None:
+                return n, sink
+        for child in ast.iter_child_nodes(n):
+            hit = scan(child)
+            if hit is not None:
+                return hit
+        return None
+
+    for stmt in body:
+        hit = scan(stmt)
+        if hit is not None:
+            return hit
+    return None
+
+
+# -- the protocol lock --------------------------------------------------------
+
+def default_lock_path() -> str:
+    from dcgan_tpu.analysis.core import default_root
+
+    return os.path.join(default_root(), "dcgan_tpu", "analysis",
+                        "protocol.lock.jsonl")
+
+
+def _scenario_status(result) -> str:
+    if any(s == "trip" for s in result.statuses):
+        return "watchdog"
+    tags = {str(o).split("@")[0] for o in result.outcomes}
+    if tags == {"completed"}:
+        return "completed"
+    if tags == {"stopped"}:
+        return "stopped"
+    if tags == {"aborted"}:
+        return "aborted"
+    return "mixed:" + ",".join(sorted(tags))
+
+
+def _canonical(result) -> List[str]:
+    """The canonical schedule: the longest among NON-hung processes (a
+    hung process's schedule ends in its hang marker and may tie the
+    survivors on length), falling back to the longest overall."""
+    alive = [s for s, st in zip(result.schedules, result.statuses)
+             if st != "hung"]
+    return list(max(alive or result.schedules, key=len))
+
+
+def _canonical_schedule(result) -> Tuple[List[str], Dict[str, int]]:
+    """(canonical schedule, {pid: prefix length} for processes whose
+    schedule is a shorter/divergent tail — the hung process of a
+    watchdog interleaving)."""
+    longest = _canonical(result)
+    truncated = {str(i): len(s) for i, s in enumerate(result.schedules)
+                 if list(s) != longest}
+    return longest, truncated
+
+
+def rows_from_results(results) -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    seen_cfg = set()
+    for r in results:
+        if r.knobs.name not in seen_cfg:
+            seen_cfg.add(r.knobs.name)
+            rows.append({"kind": "config", "name": r.knobs.name,
+                         "knobs": r.knobs.to_json()})
+        schedule, truncated = _canonical_schedule(r)
+        row: Dict[str, object] = {
+            "kind": "scenario", "config": r.knobs.name,
+            "fault": r.fault.name, "n_proc": r.knobs.n_proc,
+            "status": _scenario_status(r),
+            "outcomes": [str(o) for o in r.outcomes],
+            "schedule": schedule,
+        }
+        if truncated:
+            row["truncated"] = truncated
+        rows.append(row)
+    return rows
+
+
+def _row_key(row: Dict[str, object]) -> Tuple[str, str, str]:
+    if row.get("kind") == "config":
+        return ("config", str(row.get("name")), "")
+    return ("scenario", str(row.get("config")), str(row.get("fault")))
+
+
+def dumps(rows: Sequence[Dict[str, object]]) -> str:
+    lines = list(_HEADER)
+    for row in sorted(rows, key=_row_key):
+        lines.append(json.dumps(row, sort_keys=True,
+                                separators=(",", ":")))
+    return "\n".join(lines) + "\n"
+
+
+def loads(text: str, origin: str = "<lock>") -> List[Dict[str, object]]:
+    rows: List[Dict[str, object]] = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            row = json.loads(line)
+        except ValueError as e:
+            raise ValueError(f"{origin}:{i}: unparseable lock row: {e}") \
+                from e
+        if not isinstance(row, dict) or row.get("kind") not in (
+                "config", "scenario"):
+            raise ValueError(f"{origin}:{i}: lock row must be an object "
+                             "with kind config|scenario")
+        rows.append(row)
+    return rows
+
+
+def load_path(path: str) -> List[Dict[str, object]]:
+    with open(path, encoding="utf-8") as f:
+        return loads(f.read(), origin=path)
+
+
+def lock_diff(live: Sequence[Dict[str, object]],
+              committed: Sequence[Dict[str, object]]) -> List[Finding]:
+    """Fresh exploration vs the committed lock -> DCG012 drift findings.
+    Every difference is a finding naming the regen command — drift is a
+    protocol change and must be reviewed as one."""
+    findings: List[Finding] = []
+
+    def _f(key: str, symbol: str, message: str) -> Finding:
+        return Finding(check=LOCK_CHECK, path=LOCK_REL_PATH, line=0,
+                       symbol=symbol, key=key,
+                       message=message + f" — if intentional, regenerate "
+                       f"with `{_REGEN_CMD}` and review the diff")
+
+    live_by = {_row_key(r): r for r in live}
+    comm_by = {_row_key(r): r for r in committed}
+    for key in sorted(set(comm_by) - set(live_by)):
+        findings.append(_f(
+            "missing-row", "/".join(k for k in key if k),
+            f"committed lock row {key} no longer explored (the lattice "
+            "shrank or a config/fault was renamed)"))
+    for key in sorted(set(live_by) - set(comm_by)):
+        findings.append(_f(
+            "uncommitted-row", "/".join(k for k in key if k),
+            f"explored interleaving {key} has no committed lock row"))
+    for key in sorted(set(live_by) & set(comm_by)):
+        if live_by[key] != comm_by[key]:
+            changed = sorted(
+                k for k in set(live_by[key]) | set(comm_by[key])
+                if live_by[key].get(k) != comm_by[key].get(k))
+            findings.append(_f(
+                "schedule-drift", "/".join(k for k in key if k),
+                f"interleaving {key} drifted from the committed lock "
+                f"(changed field(s): {changed}) — the collective "
+                "schedule of the coordination protocol moved"))
+    return findings
+
+
+# -- DCG012 audit -------------------------------------------------------------
+
+def audit_results(results) -> List[Finding]:
+    """Termination + lockstep findings over one lattice exploration."""
+    findings: List[Finding] = []
+
+    def _f(r, key: str, message: str) -> Finding:
+        return Finding(check=LOCK_CHECK, path=LOCK_REL_PATH, line=0,
+                       symbol=f"{r.knobs.name}/{r.fault.name}", key=key,
+                       message=message)
+
+    for r in results:
+        if r.failure is not None and not r.watchdog_armed:
+            findings.append(_f(
+                r, "deadlock",
+                f"interleaving deadlocked with no watchdog armed: "
+                f"blocked {r.failure['waiting']}, "
+                f"absent {r.failure['absent']} — a process is stuck in a "
+                "transport a peer never enters"))
+            continue
+        if r.failure is not None:
+            # watchdog resolution: the blocked survivors must all have
+            # been waiting at ONE point (peers of a hung process stay
+            # lockstep with each other); a split is a real divergence
+            # the trip merely masked
+            points = set(r.failure["waiting"].values())
+            if len(points) > 1:
+                findings.append(_f(
+                    r, "divergence",
+                    f"processes blocked at DIFFERENT collectives "
+                    f"{r.failure['waiting']} — an asymmetric branch, not "
+                    "a hang (the watchdog trip hides a protocol bug)"))
+                continue
+            if not r.failure["hung"]:
+                findings.append(_f(
+                    r, "deadlock",
+                    f"watchdog tripped with no hung process: blocked "
+                    f"{r.failure['waiting']} while "
+                    f"{r.failure['absent']} exited — an exit path left "
+                    "peers in a collective"))
+                continue
+        if not r.terminated:
+            findings.append(_f(
+                r, "non-termination",
+                f"statuses {r.statuses} — a virtual process neither "
+                "finished nor resolved"))
+            continue
+        findings.extend(
+            _f(r, "lockstep", m) for m in _lockstep_issues(r))
+    return findings
+
+
+def _lockstep_issues(r) -> List[str]:
+    issues: List[str] = []
+    canonical = _canonical(r)
+    for pid, (sched, st) in enumerate(zip(r.schedules, r.statuses)):
+        compare = sched
+        if st == "hung" and compare and compare[-1].startswith("local:hang"):
+            compare = compare[:-1]  # the hang marker itself is expected
+        if st == "hung":
+            if compare != canonical[:len(compare)]:
+                issues.append(
+                    f"hung process {pid}'s schedule is not a prefix of "
+                    f"its peers' (diverged before the hang): "
+                    f"{_first_diff(compare, canonical)}")
+            continue
+        if sched != canonical:
+            issues.append(
+                f"process {pid}'s schedule diverges from the canonical: "
+                f"{_first_diff(sched, canonical)}")
+    done_outcomes = {str(o) for o, st in zip(r.outcomes, r.statuses)
+                     if st == "done"}
+    if len(done_outcomes) > 1:
+        issues.append(f"processes terminated with different outcomes: "
+                      f"{sorted(done_outcomes)}")
+    return issues
+
+
+def _first_diff(a: List[str], b: List[str]) -> str:
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x != y:
+            return f"position {i}: {x!r} vs {y!r}"
+    return f"length {len(a)} vs {len(b)}"
+
+
+# -- driver -------------------------------------------------------------------
+
+def run_protocol(checks: Optional[Sequence[str]] = None,
+                 lock_path: Optional[str] = None,
+                 compare_lock: bool = True
+                 ) -> Tuple[List[Finding], List[Dict[str, object]],
+                            Dict[str, object]]:
+    """(findings, lock rows, stats) for one lattice exploration. Stats
+    carry the explored-interleaving counts the CI pin prints — silent
+    lattice shrinkage must be visible in logs (the committed lock also
+    catches it as missing-row findings)."""
+    if checks:
+        unknown = sorted({c.upper() for c in checks} - set(PROTOCOL_CHECKS))
+        if unknown:
+            raise ValueError(
+                f"unknown protocol check ID(s) {unknown}; valid: "
+                f"{list(PROTOCOL_CHECKS)} (DCG013 is an AST-tier check — "
+                "run the default invocation)")
+    from dcgan_tpu.analysis import simulate
+
+    results = simulate.run_lattice()
+    findings = audit_results(results)
+    rows = rows_from_results(results)
+    per_config: Dict[str, int] = {}
+    for r in results:
+        per_config[r.knobs.name] = per_config.get(r.knobs.name, 0) + 1
+    stats = {
+        "configs": len(per_config),
+        "interleavings": len(results),
+        "per_config": dict(sorted(per_config.items())),
+    }
+    if compare_lock:
+        path = lock_path or default_lock_path()
+        if not os.path.exists(path):
+            findings.append(Finding(
+                check=LOCK_CHECK, path=LOCK_REL_PATH, line=0,
+                symbol="<lock>", key="missing-lock",
+                message=f"no committed protocol lock at {path} — run "
+                        f"`{_REGEN_CMD}` and commit the result"))
+        else:
+            findings.extend(lock_diff(rows, load_path(path)))
+    findings.sort(key=lambda f: (f.symbol, f.key))
+    return findings, rows, stats
+
+
+#: the committed scenario the live 2-process drill replays against
+#: (tools/chaos_drill.py mh-sigterm-stop logs its coordination-transport
+#: sequence under DCGAN_PROTOCOL_LOG and compares it to this row)
+DRILL_REPLAY_SCENARIO = ("drill-defaults", "sigterm@p1@3")
+
+
+def coord_ops(schedule: Sequence[str]) -> List[str]:
+    """A simulated schedule filtered to the logical coordination ops the
+    live transports log (coordination.py DCGAN_PROTOCOL_LOG lines)."""
+    from dcgan_tpu.analysis.simulate import COORD_LOG_OPS
+
+    out: List[str] = []
+    for entry in schedule:
+        kind, _, label = entry.partition(":")
+        if kind not in ("ag", "bar"):
+            continue
+        op = label.split("@")[0]
+        if op in COORD_LOG_OPS:
+            out.append(op)
+    return out
+
+
+def drill_replay_ops(lock_path: Optional[str] = None) -> List[str]:
+    """The committed coordination-op sequence for the drill's
+    mh-sigterm-stop scenario — what a live run's DCGAN_PROTOCOL_LOG must
+    reproduce exactly."""
+    rows = load_path(lock_path or default_lock_path())
+    config, fault = DRILL_REPLAY_SCENARIO
+    for row in rows:
+        if row.get("kind") == "scenario" and row.get("config") == config \
+                and row.get("fault") == fault:
+            return coord_ops([str(e) for e in row["schedule"]])
+    raise ValueError(
+        f"committed protocol lock has no {config}/{fault} scenario — the "
+        "drill replay contract is broken (regenerate the lock)")

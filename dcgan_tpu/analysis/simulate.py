@@ -1,0 +1,859 @@
+"""Protocol simulator: N virtual processes through the REAL coordination
+decision code (ISSUE 14 tentpole).
+
+Every multi-host recovery decision in this repo is supposed to be a
+deterministic collective (DESIGN.md §6c.1): anomaly consensus, the
+coordinated stop, the rollback restore/delete ordering, the elastic
+restore path choice. The classic SPMD failure is one asymmetric branch —
+a host that enters a barrier or allgather its peers skip — and until now
+the only defense was a handful of hand-picked 2-process chaos scenarios.
+This module makes the lockstep property *checkable*: it runs N virtual
+processes (threads) through the REAL decision code —
+`coordination.anomaly_consensus`, `CoordinatedStop.poll`,
+`warmup_barrier`, `fleet_health_gather`, `RollbackManager` restore (with
+its `on_restore` drain ordering), `Checkpointer.delete_steps_after`'s
+barrier+verdict protocol, and `elastic.sidecar.restore_decision` — with
+the process-level transports replaced by an in-process rendezvous, and
+records every process's collective schedule.
+
+How the shim works: the real coordination/checkpoint code bottoms out in
+exactly two jax primitives — `multihost_utils.process_allgather` and
+`multihost_utils.sync_global_devices` — plus `jax.process_count()` /
+`jax.process_index()`. The simulator patches those four (thread-local
+process identity, rendezvous transports) so every *decision* line between
+the trainer mirror and the wire is the production code, not a model of
+it. `SIM_TRANSPORTS` declares which coordination entry points are driven
+through their real bodies; tests/test_protocol.py pins it in three-way
+set equality against `tripwire.WRAPPED_TRANSPORTS` and the transport
+functions named by `coordination.TRANSPORT_CENSUS`, so a new transport
+added to any one of the three fails loudly in the other two (and
+`verify_transport_registry()` repeats the check at every `--protocol`
+run).
+
+The explored lattice is (knob config) x (one-shot fault), with faults
+expressed as real `testing/chaos.FaultPlan` instances (one per virtual
+process — the exact per-process one-shot semantics the chaos drill
+arms through DCGAN_CHAOS). Termination semantics:
+
+- an interleaving TERMINATES when every virtual process finishes
+  (completed / stopped / aborted), or — when the config arms the
+  hung-collective watchdog — when a deadlock resolves as a watchdog trip
+  on every blocked process (the hung process's schedule must be a prefix
+  of its peers');
+- a deadlock with NO watchdog armed, or any divergence between
+  per-process schedules, is a DCG012 finding (analysis/protocol.py).
+
+Deliberately NOT modeled (documented, not hidden): coord_stop=false
+multi-host SIGTERM (no handler is installed there by design — the
+process dies and jax's coordination service reaps its peers; there is no
+lockstep schedule to audit), and the watchdog's mesh-warm arming
+exemptions (the simulator treats a config's watchdog as armed for the
+whole run — phase-granular arming is a liveness optimization, not a
+schedule change).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import dataclasses
+import io
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: coordination entry points the simulator drives through their REAL
+#: bodies (the rendezvous shim sits UNDER them, at the multihost_utils
+#: primitives). Three-way set equality with tripwire.WRAPPED_TRANSPORTS
+#: and coverage of coordination.TRANSPORT_CENSUS's transport functions is
+#: enforced by verify_transport_registry() + tests/test_protocol.py.
+SIM_TRANSPORTS = ("_allgather_i32", "_allgather_f32", "fleet_health_gather",
+                  "anomaly_consensus", "warmup_barrier")
+
+#: logical collective ops that coordination.py also logs to
+#: DCGAN_PROTOCOL_LOG in live multi-host runs — the replay-comparison
+#: subset of a simulated schedule (tools/chaos_drill.py mh-sigterm-stop).
+COORD_LOG_OPS = ("stop_consensus", "anomaly_consensus", "fleet_health",
+                 "warmup_barrier")
+
+#: how long the engine waits on a rendezvous before declaring itself
+#: wedged — an ENGINE bug guard, never part of the audited semantics
+#: (deadlocks between virtual processes are detected structurally, by the
+#: last runnable thread blocking, not by timeout).
+_ENGINE_WEDGE_SECS = 60.0
+
+
+class SimProtocolError(RuntimeError):
+    """The simulator itself failed (engine wedge, crashed virtual
+    process) — distinct from a detected protocol violation, which is a
+    DCG012 finding, not an exception."""
+
+
+class _SimExit(Exception):
+    """Internal: unwinds a virtual process whose outcome is already
+    recorded (hang, watchdog trip, deadlock)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Knobs:
+    """One knob configuration — the sim's mirror of the TrainConfig
+    fields that change the collective schedule."""
+
+    name: str
+    n_proc: int = 2
+    total_steps: int = 6
+    nan_policy: str = "abort"          # "abort" | "rollback"
+    nan_check_steps: int = 2
+    coord_stop: bool = True
+    zero_stage: int = 1
+    pipeline_gd: bool = False
+    fleet_health_steps: int = 0
+    aot_warmup: bool = False
+    collective_timeout_secs: float = 0.0
+    rollback_snapshot_steps: int = 2
+    max_rollbacks: int = 2
+    restore: str = "none"              # none|same|mesh|procs — which saved
+                                       # topology the run "resumes" from
+                                       # (sidecar.restore_decision input)
+
+    def to_json(self) -> Dict[str, object]:
+        d = dataclasses.asdict(self)
+        d.pop("name")
+        return d
+
+
+@dataclasses.dataclass(frozen=True)
+class Fault:
+    """One lattice point: per-virtual-process FaultPlan fields (the
+    real testing/chaos.FaultPlan one-shot semantics) plus an optional
+    process-global transient-IO site (retry_io's chaos selector)."""
+
+    name: str
+    plans: Tuple[Tuple[int, Tuple[Tuple[str, int], ...]], ...] = ()
+    io_site: str = ""
+
+    @classmethod
+    def make(cls, name: str, plans: Optional[Dict[int, Dict[str, int]]]
+             = None, io_site: str = "") -> "Fault":
+        frozen = tuple(sorted(
+            (pid, tuple(sorted(fields.items())))
+            for pid, fields in (plans or {}).items()))
+        return cls(name=name, plans=frozen, io_site=io_site)
+
+    def plan_for(self, pid: int):
+        from dcgan_tpu.testing.chaos import FaultPlan
+
+        for p, fields in self.plans:
+            if p == pid:
+                return FaultPlan(**dict(fields))
+        return None
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    knobs: Knobs
+    fault: Fault
+    schedules: List[List[str]]
+    outcomes: List[Optional[str]]
+    statuses: List[str]
+    failure: Optional[Dict[str, object]]   # deadlock snapshot, or None
+    watchdog_armed: bool
+    crash: Optional[BaseException] = None
+
+    @property
+    def terminated(self) -> bool:
+        """No virtual process left blocked forever: every process is done
+        or hung-by-fault or resolved by a watchdog trip."""
+        if self.crash is not None:
+            return False
+        return all(s in ("done", "hung", "trip") for s in self.statuses)
+
+
+class VirtualMesh:
+    """N virtual processes + rendezvous transports + schedule recorder.
+
+    A collective completes only when ALL N processes enter the same
+    (entry, occurrence) point — exactly a real job's semantics, where a
+    process that exited or hung leaves its peers blocked forever. The
+    last thread to leave the runnable pool performs the structural
+    deadlock check; a detected deadlock resolves every blocked process as
+    a watchdog trip when the scenario arms one, else marks the scenario
+    failed (the DCG012 raw material)."""
+
+    def __init__(self, n_proc: int, *, watchdog_armed: bool = False):
+        self.n = n_proc
+        self.watchdog_armed = watchdog_armed
+        self.schedules: List[List[str]] = [[] for _ in range(n_proc)]
+        self.statuses = ["running"] * n_proc
+        self.outcomes: List[Optional[str]] = [None] * n_proc
+        self.crash: Optional[BaseException] = None
+        self._cond = threading.Condition()
+        self._pids: Dict[int, int] = {}
+        self._phases = [""] * n_proc
+        self._blocked_at: List[Optional[tuple]] = [None] * n_proc
+        self._occ = [collections.Counter() for _ in range(n_proc)]
+        self._waiters: Dict[tuple, Dict[int, object]] = {}
+        self._results: Dict[tuple, list] = {}
+        self.failure: Optional[Dict[str, object]] = None
+
+    # -- virtual process identity --------------------------------------------
+
+    def register(self, pid: int) -> None:
+        with self._cond:
+            self._pids[threading.get_ident()] = pid
+
+    def pid(self) -> int:
+        # unregistered threads (the orchestrating main thread) read as the
+        # chief — matches jax.process_index()'s single-process default
+        return self._pids.get(threading.get_ident(), 0)
+
+    @contextlib.contextmanager
+    def phase(self, label: str):
+        """Name the protocol phase for the enclosed collectives — the sim
+        counterpart of the trainer's watchdog guard labels; schedule
+        entries carry it."""
+        pid = self.pid()
+        prev = self._phases[pid]
+        self._phases[pid] = label
+        try:
+            yield
+        finally:
+            self._phases[pid] = prev
+
+    # -- schedule recording ---------------------------------------------------
+
+    def local(self, label: str) -> None:
+        """A host-local decision that must still be lockstep (recorded,
+        never blocking): restore path choice, pipeline drains."""
+        self.schedules[self.pid()].append(f"local:{label}")
+
+    def collective(self, kind: str, label: str):
+        """A named mesh-synchronous point that is not one of the patched
+        transports (program dispatch, the final collective save)."""
+        with self.phase(label):
+            return self.gather(kind, None)
+
+    # -- the rendezvous transport --------------------------------------------
+
+    def gather(self, kind: str, value, fallback_label: str = "") -> list:
+        """Block until every virtual process enters this same point; the
+        per-pid values come back index-ordered (process_allgather
+        semantics). On structural deadlock: watchdog-armed scenarios
+        resolve every blocked process as a trip; unarmed ones mark the
+        scenario failed. Either way the blocked thread unwinds."""
+        pid = self.pid()
+        with self._cond:
+            label = self._phases[pid] or fallback_label or kind
+            entry = f"{kind}:{label}"
+            self.schedules[pid].append(entry)
+            occ = self._occ[pid][entry]
+            self._occ[pid][entry] += 1
+            key = (entry, occ)
+            self._waiters.setdefault(key, {})[pid] = value
+            self.statuses[pid] = "blocked"
+            self._blocked_at[pid] = key
+            if len(self._waiters[key]) == self.n:
+                self._results[key] = [self._waiters[key][i]
+                                      for i in range(self.n)]
+                self._cond.notify_all()
+            else:
+                self._check_stuck_locked()
+            deadline = time.monotonic() + _ENGINE_WEDGE_SECS
+            while key not in self._results and self.failure is None \
+                    and self.crash is None:
+                if not self._cond.wait(timeout=1.0) \
+                        and time.monotonic() > deadline:
+                    raise SimProtocolError(
+                        f"simulator wedged: process {pid} waited "
+                        f"{_ENGINE_WEDGE_SECS:.0f}s at {entry!r} without "
+                        "structural resolution — engine bug")
+            if key in self._results:
+                self.statuses[pid] = "running"
+                self._blocked_at[pid] = None
+                return self._results[key]
+            self._blocked_at[pid] = None
+            if self.crash is not None:
+                self.statuses[pid] = "done"
+                self.outcomes[pid] = f"unwound:{label}"
+                raise _SimExit()
+            if self.watchdog_armed:
+                # the deadline guard around this phase fires on every
+                # blocked process: the job dies loudly instead of hanging
+                # (coordination.CollectiveWatchdog's contract)
+                self.statuses[pid] = "trip"
+                self.outcomes[pid] = f"watchdog-trip:{label}"
+            else:
+                self.statuses[pid] = "deadlocked"
+                self.outcomes[pid] = f"deadlocked:{label}"
+            raise _SimExit()
+
+    def _check_stuck_locked(self) -> None:
+        """Structural deadlock check, run by the last thread to leave the
+        runnable pool. No rendezvous can complete once a process is done
+        or hung (it will never arrive), or when the blocked set is split
+        across different points (the asymmetric-branch signature)."""
+        if self.failure is not None or self.crash is not None:
+            return
+        blocked = {}
+        for i in range(self.n):
+            st = self.statuses[i]
+            if st == "running":
+                return
+            if st == "blocked":
+                if self._blocked_at[i] in self._results:
+                    return  # resolved, just hasn't woken yet
+                blocked[i] = self._blocked_at[i]
+        if not blocked:
+            return  # everyone finished or hung — nothing waiting
+        self.failure = {
+            "waiting": {i: k[0] for i, k in sorted(blocked.items())},
+            "absent": sorted(i for i in range(self.n)
+                             if self.statuses[i] in ("done", "hung")),
+            "hung": sorted(i for i in range(self.n)
+                           if self.statuses[i] == "hung"),
+        }
+        self._cond.notify_all()
+
+    # -- terminal states -------------------------------------------------------
+
+    def finish(self, outcome: str) -> None:
+        pid = self.pid()
+        with self._cond:
+            self.statuses[pid] = "done"
+            self.outcomes[pid] = outcome
+            self._check_stuck_locked()
+
+    def hang(self, label: str) -> None:
+        """The chaos hang fault: this virtual process goes silent — it
+        never enters another collective, exactly `maybe_hang`'s peer-gone
+        semantics. Unwinds the thread after recording the state."""
+        pid = self.pid()
+        with self._cond:
+            self.schedules[pid].append(f"local:{label}")
+            self.statuses[pid] = "hung"
+            self.outcomes[pid] = label
+            self._check_stuck_locked()
+        raise _SimExit()
+
+    def record_crash(self, exc: BaseException) -> None:
+        with self._cond:
+            if self.crash is None:
+                self.crash = exc
+            self._cond.notify_all()
+
+
+# -- transport patching -------------------------------------------------------
+
+#: env knob coordination.py logs live collective sequences under — the
+#: simulator must run with it cleared so the REAL transport bodies it
+#: drives don't append sim traffic to a drill's replay log.
+_SCHED_LOG_ENV = "DCGAN_PROTOCOL_LOG"
+
+
+@contextlib.contextmanager
+def patched_transports(mesh: VirtualMesh):
+    """Swap the four process-level primitives for the rendezvous mesh:
+    `jax.process_count`/`jax.process_index` (thread-local virtual
+    identity) and `multihost_utils.process_allgather`/
+    `sync_global_devices` (the two wires every SIM_TRANSPORTS entry's
+    real body bottoms out in — coordination.py, and
+    Checkpointer.delete_steps_after's verdict barrier, import them at
+    call time, so a module-attribute patch reaches every call site)."""
+    import jax
+    from jax.experimental import multihost_utils as mh
+
+    saved = (jax.process_count, jax.process_index,
+             mh.process_allgather, mh.sync_global_devices,
+             os.environ.pop(_SCHED_LOG_ENV, None))
+
+    def _allgather(x, tiled=False):
+        vals = mesh.gather("ag", np.asarray(x))
+        return np.stack([np.asarray(v) for v in vals])
+
+    def _sync(name: str = "sync") -> None:
+        mesh.gather("bar", None, fallback_label=str(name))
+
+    jax.process_count = lambda: mesh.n
+    jax.process_index = mesh.pid
+    mh.process_allgather = _allgather
+    mh.sync_global_devices = _sync
+    try:
+        yield
+    finally:
+        (jax.process_count, jax.process_index,
+         mh.process_allgather, mh.sync_global_devices) = saved[:4]
+        if saved[4] is not None:
+            os.environ[_SCHED_LOG_ENV] = saved[4]
+
+
+def verify_transport_registry() -> None:
+    """The three-way transport cross-check, run before every lattice
+    exploration (and pinned as a test): SIM_TRANSPORTS ==
+    tripwire.WRAPPED_TRANSPORTS, every TRANSPORT_CENSUS row's transport
+    function is simulated, and every declared name exists in
+    coordination. A transport added to any one registry fails here."""
+    from dcgan_tpu.analysis import tripwire
+    from dcgan_tpu.train import coordination
+
+    sim = set(SIM_TRANSPORTS)
+    wrapped = set(tripwire.WRAPPED_TRANSPORTS)
+    if sim != wrapped:
+        raise SimProtocolError(
+            f"transport registries diverged: simulator shims {sorted(sim)} "
+            f"but the runtime tripwire wraps {sorted(wrapped)} — add the "
+            "new transport to BOTH (analysis/simulate.SIM_TRANSPORTS, "
+            "analysis/tripwire.WRAPPED_TRANSPORTS)")
+    census_fns = {row[0] for row in coordination.TRANSPORT_CENSUS.values()}
+    if not census_fns <= sim:
+        raise SimProtocolError(
+            f"TRANSPORT_CENSUS names transport function(s) "
+            f"{sorted(census_fns - sim)} the simulator does not drive — "
+            "add them to analysis/simulate.SIM_TRANSPORTS (and teach the "
+            "virtual trainer to exercise them)")
+    for name in sorted(sim):
+        if not callable(getattr(coordination, name, None)):
+            raise SimProtocolError(
+                f"SIM_TRANSPORTS entry {name!r} is not a coordination "
+                "callable — registry drifted from the code")
+
+
+# -- the sidecar decision's target tree ---------------------------------------
+
+_SIDECAR_STATE = None
+
+
+def _sidecar_state():
+    """A 1-leaf sharded tree on a real 1-device mesh, built ONCE before
+    any transport patching (device placement must not run under a
+    patched process_index). `sidecar.restore_decision` reads only its
+    mesh axes/sizes plus jax.process_count() — which IS patched, so the
+    decision sees the virtual process census."""
+    global _SIDECAR_STATE
+    if _SIDECAR_STATE is None:
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+        mesh = Mesh(np.asarray(jax.devices()[:1]), ("data",))
+        _SIDECAR_STATE = {"w": jax.device_put(
+            np.zeros(2, np.float32), NamedSharding(mesh, PartitionSpec()))}
+    return _SIDECAR_STATE
+
+
+def _restore_payload(knobs: Knobs) -> Dict[str, object]:
+    """The saved-topology sidecar payload each restore variant resumes
+    from, crafted against the 1-device live mesh above: `same` matches,
+    `mesh` changes the axis sizes only (device path), `procs` changes the
+    process count (host path)."""
+    if knobs.restore == "same":
+        return {"mesh": {"axes": ["data"], "sizes": [1]},
+                "process_count": knobs.n_proc}
+    if knobs.restore == "mesh":
+        return {"mesh": {"axes": ["data"], "sizes": [2]},
+                "process_count": knobs.n_proc}
+    if knobs.restore == "procs":
+        return {"mesh": {"axes": ["data"], "sizes": [1]},
+                "process_count": knobs.n_proc + 1}
+    raise ValueError(f"unknown restore variant {knobs.restore!r}")
+
+
+# -- the checkpoint-delete protocol's real executor ---------------------------
+
+class _FakeMgr:
+    """The minimal CheckpointManager surface delete_steps_after touches:
+    wait/reload are host-local no-ops here, single-process delete is the
+    real directory removal."""
+
+    def __init__(self, directory: str):
+        self._dir = directory
+
+    def wait_until_finished(self) -> None:
+        pass
+
+    def reload(self) -> None:
+        pass
+
+    def delete(self, step: int) -> None:
+        shutil.rmtree(os.path.join(self._dir, str(step)),
+                      ignore_errors=True)
+
+
+def make_sim_checkpointer(directory: str):
+    """A Checkpointer whose `delete_steps_after` is the REAL method —
+    real chief-only rmtree + retry_io + the unconditional verdict
+    allgather/barrier — against a plain directory of integer step dirs,
+    with the Orbax manager faked out (no async machinery, no device
+    arrays). The simulator audits the delete ORDERING contract through
+    the production code path, not a model of it."""
+    from dcgan_tpu.utils import checkpoint as ckpt_mod
+
+    c = ckpt_mod.Checkpointer.__new__(ckpt_mod.Checkpointer)
+    c.directory = directory
+    c._mgr = _FakeMgr(directory)
+    c._pending_sidecars = {}
+    return c
+
+
+# -- the virtual trainer ------------------------------------------------------
+
+def _virtual_trainer(mesh: VirtualMesh, pid: int, knobs: Knobs,
+                     plan, ckpt) -> str:
+    """One virtual process's run: the trainer's boundary-poll branch
+    structure (train/trainer.py `_train_run` loop — see the PROTOCOL
+    anchor comment there) with every protocol DECISION taken by the real
+    coordination/rollback/checkpoint/sidecar code, every collective a
+    rendezvous, and host-local work elided. Returns the termination tag.
+
+    Field-for-field mapping to _train_run (kept in lockstep with the
+    trainer; protocol.lock.jsonl drift is the tripwire for edits there):
+    boundary order = self-signal fault -> stop poll -> hang fault ->
+    dispatch -> lag-by-one consume (deferred default) -> fleet-health
+    cadence -> snapshot-certify (forced gate + early consume + snapshot)
+    -> next boundary; loop exit -> final lag-by-one flush (a trip here
+    aborts under BOTH nan policies) -> final collective save.
+    """
+    import signal as _signal
+
+    from dcgan_tpu.elastic import sidecar
+    from dcgan_tpu.train import coordination
+    from dcgan_tpu.train.rollback import RollbackManager
+
+    n = mesh.n
+    chief = pid == 0
+    state = {"w": np.zeros(2, np.float32)}
+    step_num = 0
+    total = knobs.total_steps
+
+    # elastic restore decision (Checkpointer.restore_latest's first act:
+    # sidecar read -> path choice, zero payload bytes) — host-local and
+    # mesh-uniform by construction; recorded so an asymmetric choice
+    # would break the lockstep audit
+    if knobs.restore != "none":
+        path, _mismatch = sidecar.restore_decision(
+            _restore_payload(knobs), _sidecar_state())
+        mesh.local(f"restore:{path}")
+
+    # AOT warmup proof barrier (trainer setup, --aot_warmup)
+    if knobs.aot_warmup:
+        with mesh.phase("warmup_barrier@start"):
+            coordination.warmup_barrier()
+
+    stop = coordination.CoordinatedStop()
+    rollback = None
+    if knobs.nan_policy == "rollback":
+        rollback = RollbackManager(
+            every=knobs.rollback_snapshot_steps,
+            max_rollbacks=knobs.max_rollbacks, chief=chief,
+            device_resident=False)  # host-mode over numpy leaves: the
+        # REAL ordering contract (budget check -> on_restore drain ->
+        # restore) with zero device dispatches
+        if knobs.pipeline_gd:
+            # the trainer parks the pipelined-stack drain on the
+            # manager's restore hook (ISSUE 7) — the sim records the
+            # drain so its ordering is part of the audited schedule
+            rollback.on_restore = \
+                lambda: mesh.local("pipeline-drain:rollback")
+        rollback.snapshot(step_num, state)
+
+    primed = False
+    pending: Optional[dict] = None
+
+    def _gate(rec: dict, *, force: bool = False) -> None:
+        """_nan_gate's protocol skeleton: cadence/force keying, the
+        chaos one-shot poisoning of THIS process's view, then the real
+        anomaly_consensus — a raise is mesh-symmetric by construction."""
+        s = rec["step"]
+        if not force and not (knobs.nan_check_steps
+                              and s % knobs.nan_check_steps == 0):
+            return
+        local_bad = bool(plan and plan.nan_at_step
+                         and plan.nan_at_step == s
+                         and plan.fire_once("nan_at_step"))
+        with mesh.phase(f"anomaly_consensus@{s}"):
+            bad, trippers = coordination.anomaly_consensus(local_bad)
+        if bad:
+            err = FloatingPointError(
+                f"non-finite metrics at step {s} (process(es) {trippers})")
+            err.step = s
+            raise err
+
+    def _do_rollback(e: FloatingPointError) -> None:
+        """The trainer's _do_rollback collective half: real restore
+        (budget check, on_restore drain, snapshot copy-back), then the
+        real delete_steps_after barrier+verdict protocol."""
+        nonlocal state, step_num, pending, primed
+        state, step_num = rollback.restore(e)
+        pending = None
+        if knobs.pipeline_gd:
+            primed = False  # drained: refills at the next dispatch
+        with mesh.phase(f"rollback_delete@{getattr(e, 'step', step_num)}"):
+            ckpt.delete_steps_after(step_num)
+
+    stop_sig = None
+    while step_num < total:
+        # chaos.maybe_self_signal: the one-shot handler's only effect is
+        # the process-local flag (threads cannot take real signals)
+        if plan and plan.sigterm_at_step \
+                and plan.sigterm_at_step == step_num \
+                and plan.fire_once("sigterm_at_step"):
+            stop._signal_num = _signal.SIGTERM
+        stop_sig = None
+        if n == 1:
+            stop_sig, _origins = stop.poll()
+        elif knobs.coord_stop:
+            with mesh.phase(f"stop_consensus@{step_num}"):
+                stop_sig, _origins = stop.poll()
+        if stop_sig is not None:
+            if knobs.pipeline_gd and primed:
+                mesh.local("pipeline-drain:coordinated-stop")
+            break
+        # chaos.maybe_hang: this process goes silent inside the guarded
+        # dispatch window; peers block in the next collective
+        if plan and plan.hang_at_step \
+                and plan.hang_at_step == step_num \
+                and plan.fire_once("hang_at_step"):
+            mesh.hang(f"hang@{step_num}")
+        # step dispatch: SPMD programs are mesh-synchronous — the
+        # schedule entry names which program the stream runs (the ZeRO
+        # stage changes its collective content, DESIGN §6i)
+        zs = f"@zero{knobs.zero_stage}" if knobs.zero_stage > 1 else ""
+        if knobs.pipeline_gd:
+            if not primed:
+                mesh.collective("prog", f"gen_fakes{zs}@{step_num}")
+                primed = True
+            mesh.collective("prog", f"d_update{zs}@{step_num}")
+            mesh.collective("prog", f"g_update{zs}@{step_num}")
+        else:
+            mesh.collective("prog", f"train_step{zs}@{step_num}")
+        new_step = step_num + 1
+        cur = {"step": new_step}
+        # deferred lag-by-one consume (async services default): the
+        # PREVIOUS record's gate runs after this step's dispatch
+        if pending is not None:
+            prev, pending = pending, None
+            try:
+                _gate(prev)
+            except FloatingPointError as e:
+                if rollback is None:
+                    raise
+                _do_rollback(e)
+                continue
+        pending = cur
+        # fleet health cadence (dispatch thread, new_step keyed)
+        if knobs.fleet_health_steps \
+                and new_step % knobs.fleet_health_steps == 0:
+            vec = np.asarray([new_step, 0, 0, 0, 0, 0, 0], np.float32)
+            with mesh.phase(f"fleet_health@{new_step}"):
+                coordination.fleet_health_gather(vec)
+        # snapshot-certify (trainer: forced gate + early lag-by-one
+        # flush + snapshot, all inside one guarded window)
+        if rollback is not None and rollback.due(new_step):
+            try:
+                _gate(cur, force=True)
+                if pending is not None:
+                    _gate(pending)
+                    pending = None
+                rollback.snapshot(new_step, state)
+            except FloatingPointError as e:
+                _do_rollback(e)
+                continue
+        step_num = new_step
+    # final lag-by-one flush: a NaN in the last window aborts under BOTH
+    # policies (the trainer calls _consume_metrics directly — a poisoned
+    # state must never reach the final save)
+    if pending is not None:
+        _gate(pending)
+        pending = None
+    # final forced collective save (stop and completion exits both land
+    # here; exception exits never do)
+    mesh.collective("save", f"final_save@{step_num}")
+    return f"stopped@{step_num}" if stop_sig is not None \
+        else f"completed@{step_num}"
+
+
+def _virtual_process_main(mesh: VirtualMesh, pid: int, fn: Callable[[], str]
+                          ) -> None:
+    """Thread body for one virtual process: each sim thread IS the
+    dispatch thread of its virtual process (declared in
+    analysis/core.Config.dispatch_thread_targets — DCG001's allowlist —
+    exactly like the serve worker)."""
+    mesh.register(pid)
+    try:
+        mesh.finish(fn())
+    except _SimExit:
+        pass
+    except FloatingPointError as e:
+        # mesh-symmetric abort: the gate verdict came from consensus, so
+        # every process raises at the same schedule position
+        mesh.finish(f"aborted@{getattr(e, 'step', '?')}")
+    except BaseException as e:  # engine/caller bug — surface loudly
+        mesh.record_crash(e)
+
+
+def run_scenario(knobs: Knobs, fault: Fault,
+                 program: Optional[Callable] = None) -> ScenarioResult:
+    """Run one (knobs, fault) interleaving to completion. `program`
+    overrides the virtual trainer (fixture scenarios: deliberate
+    asymmetric protocols for the DCG012 self-test); it is called as
+    program(mesh, pid, knobs, plan) and returns the outcome tag."""
+    from dcgan_tpu.testing import chaos
+
+    mesh = VirtualMesh(knobs.n_proc,
+                       watchdog_armed=knobs.collective_timeout_secs > 0)
+    workdir = tempfile.mkdtemp(prefix="dcgan-protosim-")
+    prev_plan = chaos.active_plan()
+    sink = io.StringIO()
+    try:
+        if knobs.nan_policy == "rollback":
+            # a pre-existing newer step dir (as if saved before the run
+            # died) so a rollback's delete protocol has real work: the
+            # chief rmtrees it, the verdict allgather reports success
+            os.makedirs(os.path.join(workdir, str(knobs.total_steps - 2)),
+                        exist_ok=True)
+        chaos.set_plan(chaos.FaultPlan(io_error_once=fault.io_site)
+                       if fault.io_site else None)
+        with patched_transports(mesh), contextlib.redirect_stdout(sink):
+            threads = []
+            for pid in range(knobs.n_proc):
+                ckpt = make_sim_checkpointer(workdir)
+                if program is not None:
+                    fn = (lambda p=pid, f=fault:
+                          program(mesh, p, knobs, f.plan_for(p)))
+                else:
+                    fn = (lambda p=pid, f=fault, c=ckpt:
+                          _virtual_trainer(mesh, p, knobs, f.plan_for(p),
+                                           c))
+                t = threading.Thread(
+                    target=_virtual_process_main, args=(mesh, pid, fn),
+                    name=f"dcgan-protosim-p{pid}", daemon=True)
+                threads.append(t)
+                t.start()
+            for t in threads:
+                t.join(timeout=_ENGINE_WEDGE_SECS + 30)
+                if t.is_alive():
+                    raise SimProtocolError(
+                        f"virtual process thread {t.name} did not "
+                        "terminate — engine bug")
+    finally:
+        chaos.set_plan(prev_plan)
+        shutil.rmtree(workdir, ignore_errors=True)
+    if mesh.crash is not None:
+        raise SimProtocolError(
+            f"virtual process crashed in scenario "
+            f"{knobs.name}/{fault.name}: {mesh.crash!r}") from mesh.crash
+    return ScenarioResult(
+        knobs=knobs, fault=fault, schedules=mesh.schedules,
+        outcomes=mesh.outcomes, statuses=mesh.statuses,
+        failure=mesh.failure, watchdog_armed=mesh.watchdog_armed)
+
+
+# -- the explored lattice -----------------------------------------------------
+
+def configs() -> List[Knobs]:
+    """The knob matrix. `drill-defaults` mirrors tools/chaos_drill.py's
+    multi-host scenario config exactly (trainer-default cadences) — its
+    sigterm@p1@3 row is the committed schedule the live drill replays
+    against."""
+    return [
+        Knobs("drill-defaults", nan_check_steps=100),
+        Knobs("consensus-abort", nan_check_steps=2, fleet_health_steps=2),
+        Knobs("rollback", nan_policy="rollback", nan_check_steps=1,
+              aot_warmup=True, restore="same"),
+        Knobs("pipelined-zero2", nan_policy="rollback", nan_check_steps=2,
+              zero_stage=2, pipeline_gd=True, aot_warmup=True,
+              rollback_snapshot_steps=3),
+        Knobs("zero3-fleet", zero_stage=3, fleet_health_steps=2,
+              restore="mesh"),
+        Knobs("elastic-host-restore", total_steps=4,
+              nan_policy="rollback", restore="procs"),
+        Knobs("watchdog", nan_policy="rollback",
+              collective_timeout_secs=8.0),
+        Knobs("local-stop", coord_stop=False),
+        Knobs("single-proc", n_proc=1, total_steps=5,
+              nan_policy="rollback", nan_check_steps=1),
+    ]
+
+
+def faults_for(k: Knobs) -> List[Fault]:
+    """The one-shot fault lattice for one config, keyed by the real
+    FaultPlan fields (nan_at_step / sigterm_at_step / hang_at_step /
+    io_error_once). Gate-cadence-aligned NaN steps so every armed fault
+    actually fires; sigterm excluded under coord_stop=False multi-host
+    (no handler installed there — see the module docstring)."""
+    F = Fault.make
+    gate = k.nan_check_steps if k.nan_check_steps <= k.total_steps else 0
+    out = [F("clean")]
+    if gate:
+        s = max(gate, 2)
+        s -= s % k.nan_check_steps
+        s = s or k.nan_check_steps
+        late = (k.total_steps // k.nan_check_steps) * k.nan_check_steps
+        out += [F(f"nan@p0@{s}", {0: {"nan_at_step": s}})]
+        if k.n_proc > 1:
+            out += [
+                F(f"nan@p1@{s}", {1: {"nan_at_step": s}}),
+                F(f"nan@both@{s}", {0: {"nan_at_step": s},
+                                    1: {"nan_at_step": s}}),
+            ]
+        if late != s:
+            out.append(F(f"nan@p0@{late}", {0: {"nan_at_step": late}}))
+            if k.n_proc > 1:
+                out.append(F(f"nan@p1@{late}",
+                             {1: {"nan_at_step": late}}))
+        if k.nan_policy == "rollback":
+            out.append(F(f"nan@p0@{s}+io-ckpt-delete",
+                         {0: {"nan_at_step": s}}, io_site="ckpt-delete"))
+            if k.n_proc > 1 and late != s:
+                # two independent rollbacks in one run, tripped by
+                # different hosts at different gate steps
+                out.append(F(f"nan@p0@{s}-then-p1@{late}",
+                             {0: {"nan_at_step": s},
+                              1: {"nan_at_step": late}}))
+    if k.coord_stop or k.n_proc == 1:
+        mid = min(3, k.total_steps - 1)
+        out.append(F(f"sigterm@p0@{mid}", {0: {"sigterm_at_step": mid}}))
+        if k.n_proc == 1:
+            out.append(F("sigterm@p0@1", {0: {"sigterm_at_step": 1}}))
+        if k.n_proc > 1:
+            out += [
+                F(f"sigterm@p1@{mid}", {1: {"sigterm_at_step": mid}}),
+                F(f"sigterm@both@{mid}", {0: {"sigterm_at_step": mid},
+                                          1: {"sigterm_at_step": mid}}),
+            ]
+            # step 0 cannot arm (FaultPlan's zero fields are unarmed, the
+            # chaos-hook truthiness contract) — step 1 is the earliest
+            out.append(F("sigterm@p0@1", {0: {"sigterm_at_step": 1}}))
+        if k.name == "drill-defaults":
+            out.append(F(f"sigterm@p1@{k.total_steps - 1}",
+                         {1: {"sigterm_at_step": k.total_steps - 1}}))
+    if k.collective_timeout_secs > 0 and k.n_proc > 1:
+        out += [
+            F("hang@p1@3", {1: {"hang_at_step": 3}}),
+            F("hang@p0@1", {0: {"hang_at_step": 1}}),
+            F("hang@p1@5", {1: {"hang_at_step": 5}}),
+            F("hang@p0@2", {0: {"hang_at_step": 2}}),
+        ]
+    # de-duplicate by name (cadence arithmetic can collide), keep order
+    seen, unique = set(), []
+    for f in out:
+        if f.name not in seen:
+            seen.add(f.name)
+            unique.append(f)
+    return unique
+
+
+def run_lattice() -> List[ScenarioResult]:
+    """Explore every (config, fault) interleaving. Deterministic: the
+    rendezvous transports force the only schedule the protocol admits,
+    so two runs produce byte-identical results."""
+    verify_transport_registry()
+    _sidecar_state()  # built before any transport patching
+    results = []
+    for k in configs():
+        for f in faults_for(k):
+            results.append(run_scenario(k, f))
+    return results

@@ -139,3 +139,35 @@ def topology_mismatch(payload: Dict[str, Any],
     if saved_procs != procs:
         diffs.append(f"processes {saved_procs} -> {procs}")
     return "; ".join(diffs) if diffs else None
+
+
+def restore_decision(payload: Optional[Dict[str, Any]],
+                     state: Pytree) -> Tuple[str, Optional[str]]:
+    """THE restore-path choice, as data: ("direct"|"device"|"host",
+    human-readable mismatch or None).
+
+    - "direct": topology matches (or is unknowable) — the untouched
+      pre-elastic read path;
+    - "device": mesh changed, process census did not — the Orbax read is
+      directed at the current NamedShardings;
+    - "host": process census changed — numpy staging + per-shard upload
+      (collective-free by construction).
+
+    One function, two consumers (ISSUE 14): `Checkpointer.restore_latest`
+    branches on it, and the protocol simulator
+    (analysis/simulate.py) replays it under a virtual process census —
+    the decision's inputs (committed sidecar payload, target tree's mesh,
+    jax.process_count()) are mesh-uniform, so the chosen path is
+    identical on every process BY CONSTRUCTION, and the lockstep audit
+    pins that construction.
+    """
+    mismatch = topology_mismatch(payload, state) \
+        if payload is not None else None
+    if mismatch is None:
+        return "direct", None
+    import jax
+
+    saved_procs = int(payload.get("process_count", 1))
+    if saved_procs != jax.process_count():
+        return "host", mismatch
+    return "device", mismatch

@@ -78,6 +78,33 @@ TRANSPORT_CENSUS = {
 }
 
 
+#: env knob: a file path; when set, every REAL multi-host collective the
+#: transports below run appends its logical op name (one line per
+#: collective, `.{process_index}` suffixed per process). The protocol
+#: tier's replay contract (ISSUE 14): tools/chaos_drill.py arms this in
+#: the mh-sigterm-stop scenario and compares both processes' logged
+#: sequences against the committed simulator schedule in
+#: analysis/protocol.lock.jsonl — the proof the simulated trainer mirror
+#: and the live trainer issue the same collective stream. Off (unset) in
+#: production: zero IO, zero branches beyond one env read.
+SCHEDULE_LOG_ENV = "DCGAN_PROTOCOL_LOG"
+
+
+def _sched_log(op: str) -> None:
+    """Append one logical collective-op line to the replay log, if armed.
+    Best-effort by contract — observation must never break the protocol
+    it observes."""
+    path = os.environ.get(SCHEDULE_LOG_ENV, "")
+    if not path:
+        return
+    try:
+        with open(f"{path}.{jax.process_index()}", "a",
+                  encoding="utf-8") as f:
+            f.write(op + "\n")
+    except OSError:
+        pass
+
+
 def _allgather_i32(value: int) -> np.ndarray:
     """One int32 from every process, index-ordered. The single collective
     primitive everything here is built from — kept module-level so tests
@@ -115,6 +142,7 @@ def fleet_health_gather(vec) -> np.ndarray:
     local = np.asarray(vec, np.float32).reshape(1, -1)
     if jax.process_count() == 1:
         return local
+    _sched_log("fleet_health")
     return _allgather_f32(local.ravel())
 
 
@@ -157,6 +185,7 @@ def anomaly_consensus(local_bad: bool) -> Tuple[bool, List[int]]:
     """
     if jax.process_count() == 1:
         return bool(local_bad), [0] if local_bad else []
+    _sched_log("anomaly_consensus")
     gathered = _allgather_i32(int(bool(local_bad)))
     return bool(gathered.any()), [int(i) for i in np.nonzero(gathered)[0]]
 
@@ -176,6 +205,7 @@ def warmup_barrier(tag: str = "aot-warmup") -> None:
         return
     from jax.experimental import multihost_utils
 
+    _sched_log("warmup_barrier")
     multihost_utils.sync_global_devices(tag)
 
 
@@ -228,6 +258,7 @@ class CoordinatedStop:
         local = self._signal_num or 0
         if jax.process_count() == 1:
             return (self._signal_num, [0] if self._signal_num else [])
+        _sched_log("stop_consensus")
         gathered = _allgather_i32(local)
         if not gathered.any():
             return None, []

@@ -1132,6 +1132,16 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         # restore — both trusted (the checkpoint passed integrity
         # verification; a NaN could not have been saved past the gate)
         rollback.snapshot(step_num, state)
+    # PROTOCOL ANCHOR (ISSUE 14): the boundary-poll branch structure of
+    # this loop — self-signal fault, stop poll, hang fault, dispatch,
+    # lag-by-one consume, fleet-health cadence, snapshot-certify, and the
+    # post-loop final flush + final save — is mirrored step-for-step by
+    # the protocol simulator (analysis/simulate.py::_virtual_trainer),
+    # which drives the REAL coordination/rollback/checkpoint decision
+    # code through it and lockstep-audits every collective schedule.
+    # Reordering collectives here WILL drift analysis/protocol.lock.jsonl
+    # (a DCG012 finding); update the mirror with the change and
+    # regenerate the lock deliberately.
     try:
         while step_num < total_steps:
             svc.raise_if_failed()  # a dead telemetry worker fails loudly

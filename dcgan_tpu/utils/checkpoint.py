@@ -693,17 +693,20 @@ class Checkpointer:
         abstract = _reshard.device_abstract(target_state)
         for step in self._finalized_steps():
             # topology decision first: zero payload bytes move before the
-            # reshard-vs-direct choice is made
+            # reshard-vs-direct choice is made. The choice itself is
+            # elastic/sidecar.restore_decision — shared with the protocol
+            # simulator (ISSUE 14), which replays it under a virtual
+            # process census and lockstep-audits the branch
             payload = _sidecar.read(self.directory, step)
-            mismatch = _sidecar.topology_mismatch(payload, target_state) \
-                if payload is not None else None
+            path_kind, mismatch = _sidecar.restore_decision(payload,
+                                                            target_state)
             step_abstract, assemble, reshard_info = abstract, None, None
             if mismatch is not None:
                 saved_procs = int(payload.get("process_count", 1))
                 saved_devices = 1
                 for s in payload["mesh"]["sizes"]:
                     saved_devices *= int(s)
-                host_stage = saved_procs != jax.process_count()
+                host_stage = path_kind == "host"
                 if host_stage:
                     step_abstract = _reshard.host_abstract(target_state)
                     assemble = lambda t: _reshard.put_host_tree(

@@ -309,80 +309,105 @@ class TestTrainerLoopParsing:
         assert pts == [(500, 30.0), (1000, 33.2), (5000, 46.0)]
 
 
-class TestAnalysisSmoke:
-    """ISSUE 8's tier-1 pin (the chaos-marker pattern's tool-subprocess
-    shape): `python -m dcgan_tpu.analysis` over the whole package must
-    stay CLEAN — zero non-baselined findings — inside a short budget, so
-    any new collective-thread / donation / shard_map / parity-key /
-    traced-hygiene / bare-IO violation fails the tier before it fails a
-    mesh. Suppressions and the committed baseline are the escape hatches
-    (each baseline entry carries its justification)."""
+class TestAnalysisAllSmoke:
+    """THE consolidated analyzer pin (ISSUE 14, replacing the separate
+    AST + semantic subprocess pins): ONE `python -m dcgan_tpu.analysis
+    --all` subprocess must run every tier CLEAN — zero non-baselined
+    findings across DCG001-015 — AND regenerate BOTH committed contracts
+    (analysis/programs.lock.jsonl, analysis/protocol.lock.jsonl)
+    byte-identically. `--write-manifest/--write-lock <tmp>` recompute
+    every row (exit code still gated on the non-drift findings), and the
+    byte compares against the committed files ARE the drift checks at
+    full strength. The CLI arranges its own canonical topology (CPU, 2
+    virtual devices) before jax initializes, so the pin is
+    environment-stable. Per-tier flags keep working and are covered
+    in-process (tests/test_analysis.py, tests/test_protocol.py) plus the
+    dedicated --protocol subprocess pin below."""
 
-    def test_analyzer_clean_over_package_within_budget(self):
-        import time
-
-        t0 = time.monotonic()
-        res = subprocess.run(
-            [sys.executable, "-m", "dcgan_tpu.analysis", "--json"],
-            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
-            capture_output=True, text=True, timeout=120)
-        elapsed = time.monotonic() - t0
-        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
-        rows = [json.loads(l) for l in res.stdout.splitlines() if l.strip()]
-        summary = rows[-1]
-        assert summary["label"] == "dcgan-analysis"
-        assert summary["new_findings"] == 0
-        assert summary["files"] > 50  # the walk really covered the package
-        # a plain AST pass: seconds, not minutes — the budget keeps the
-        # tier-1 pin from quietly eating the tier
-        assert elapsed < 60, f"analyzer took {elapsed:.0f}s"
-
-
-class TestSemanticAnalysisSmoke:
-    """ISSUE 11's tier-1 pin: `python -m dcgan_tpu.analysis --semantic`
-    must run CLEAN — zero non-baselined findings across DCG007-010 AND
-    zero unexplained drift against the committed program manifest — and
-    regenerating `analysis/programs.lock.jsonl` must be byte-identical
-    (the manifest is a deterministic contract, not a report). One
-    subprocess does both: `--write-manifest <tmp>` recomputes every row
-    (exit code still gated on the non-drift findings), and the byte
-    compare against the committed file IS the drift check at full
-    strength. The CLI arranges its own canonical topology (CPU, 2 virtual
-    devices) before jax initializes, so the pin is environment-stable."""
-
-    def test_semantic_clean_and_manifest_reproducible_within_budget(
+    def test_all_tiers_clean_and_locks_reproducible_within_budget(
             self, tmp_path):
         import time
 
-        committed = os.path.join(
+        committed_manifest = os.path.join(
             REPO, "dcgan_tpu", "analysis", "programs.lock.jsonl")
-        out = str(tmp_path / "programs.lock.jsonl")
+        committed_lock = os.path.join(
+            REPO, "dcgan_tpu", "analysis", "protocol.lock.jsonl")
+        out_manifest = str(tmp_path / "programs.lock.jsonl")
+        out_lock = str(tmp_path / "protocol.lock.jsonl")
         t0 = time.monotonic()
         res = subprocess.run(
-            [sys.executable, "-m", "dcgan_tpu.analysis", "--semantic",
-             "--json", "--write-manifest", out],
+            [sys.executable, "-m", "dcgan_tpu.analysis", "--all",
+             "--json", "--write-manifest", out_manifest,
+             "--write-lock", out_lock],
             cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
             capture_output=True, text=True, timeout=420)
         elapsed = time.monotonic() - t0
         assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-800:])
-        summary = json.loads(res.stdout.splitlines()[-1])
-        assert summary["label"] == "dcgan-analysis-semantic"
+        summary = json.loads(
+            [l for l in res.stdout.splitlines()
+             if l.startswith("{")][-1])
+        assert summary["label"] == "dcgan-analysis-all"
         assert summary["new_findings"] == 0
-        # the enumeration really covered the dispatch surface: both
-        # backends' program tables + backoff variants + the ZeRO-2/3
-        # stage variants (ISSUE 13) + serve rungs + the declared
-        # coordination transports
-        assert summary["programs"] > 60
-        with open(out, "rb") as f_new, open(committed, "rb") as f_old:
-            assert f_new.read() == f_old.read(), (
-                "regenerated manifest differs from the committed "
-                "programs.lock.jsonl — either the programs drifted "
-                "(regenerate deliberately and review the diff) or "
-                "determinism broke")
-        # lowering ~70 programs + compiling the donating ones on 2 CPU
-        # devices (~60 s measured on a quiet 2-core host) — the budget
-        # keeps the tier-1 pin from quietly eating the tier
-        assert elapsed < 240, f"semantic analyzer took {elapsed:.0f}s"
+        tiers = summary["tiers"]
+        # per-tier timing is part of the contract: a tier that silently
+        # stopped running would report no timing row
+        assert set(tiers) == {"ast", "semantic", "protocol"}
+        assert all(t["ms"] > 0 for t in tiers.values())
+        assert tiers["ast"]["files"] > 50
+        assert tiers["semantic"]["programs"] > 60
+        # the protocol lattice really explored (ISSUE 14 acceptance:
+        # >= 4 configs x >= 6 interleavings); the stderr line makes
+        # silent shrinkage visible in CI logs
+        assert tiers["protocol"]["configs"] >= 4
+        assert tiers["protocol"]["interleavings"] >= 24
+        assert "explored" in res.stderr and "interleaving" in res.stderr
+        for out, committed, what in (
+                (out_manifest, committed_manifest, "programs.lock.jsonl"),
+                (out_lock, committed_lock, "protocol.lock.jsonl")):
+            with open(out, "rb") as f_new, open(committed, "rb") as f_old:
+                assert f_new.read() == f_old.read(), (
+                    f"regenerated {what} differs from the committed file "
+                    "— either the contract drifted (regenerate "
+                    "deliberately and review the diff) or determinism "
+                    "broke")
+        # AST ~3 s + semantic ~60 s + protocol ~2 s measured on a quiet
+        # 2-core host — the budget keeps the tier-1 pin from quietly
+        # eating the tier
+        assert elapsed < 300, f"--all took {elapsed:.0f}s"
+
+
+class TestProtocolAnalysisSmoke:
+    """ISSUE 14's dedicated tier pin: `--protocol --json` alone must run
+    clean inside a tight budget (the simulator is pure host code — if it
+    slows down, its lattice grew in a way someone should look at), and
+    must PRINT the explored-interleaving counts so lattice shrinkage can
+    never be silent in logs."""
+
+    def test_protocol_clean_within_budget(self):
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "-m", "dcgan_tpu.analysis", "--protocol",
+             "--json"],
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=180)
+        elapsed = time.monotonic() - t0
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-800:])
+        summary = json.loads(
+            [l for l in res.stdout.splitlines()
+             if l.startswith("{")][-1])
+        assert summary["label"] == "dcgan-analysis-protocol"
+        assert summary["new_findings"] == 0
+        assert summary["configs"] >= 4
+        assert summary["interleavings"] >= 24
+        import re as _re
+
+        m = _re.search(r"explored (\d+) interleaving\(s\) across (\d+) "
+                       r"knob config\(s\)", res.stderr)
+        assert m, f"no interleaving-count line in stderr: {res.stderr}"
+        assert int(m.group(1)) == summary["interleavings"]
+        assert elapsed < 120, f"protocol tier took {elapsed:.0f}s"
 
 
 @pytest.mark.chaos

@@ -674,14 +674,16 @@ def _free_port() -> int:
 
 def _run_mh_train(extra: dict, *, max_steps: int, chaos: dict = None,
                   nproc: int = 2, timeout: int = 600,
-                  extra_per_pid: dict = None):
+                  extra_per_pid: dict = None, env_common: dict = None):
     """One 2-process trainer job; returns [(rc, output) per process].
 
     `chaos` may be a flat FaultPlan dict (armed on every process) or a
     per-process map like {"1": {...}} (armed on that MH_PID only).
     `extra_per_pid` ({pid: {config overrides}}) layers per-process config
     on top of `extra` — only for knobs that are legitimately per-process
-    (watchdog deadlines); anything steering collectives must stay common."""
+    (watchdog deadlines); anything steering collectives must stay common.
+    `env_common` adds environment variables to EVERY process (the
+    protocol-replay scenario arms DCGAN_PROTOCOL_LOG this way)."""
     port = _free_port()
     procs = []
     for pid in range(nproc):
@@ -692,6 +694,9 @@ def _run_mh_train(extra: dict, *, max_steps: int, chaos: dict = None,
                    MH_MAX_STEPS=str(max_steps))
         env.pop("DCGAN_CHAOS", None)
         env.pop("JAX_COORDINATOR_ADDRESS", None)
+        env.pop("DCGAN_PROTOCOL_LOG", None)
+        if env_common:
+            env.update(env_common)
         if chaos:
             env["DCGAN_CHAOS"] = json.dumps(chaos)
         procs.append(subprocess.Popen(
@@ -743,11 +748,21 @@ def scenario_mh_nan_rollback(root: str) -> dict:
 def scenario_mh_sigterm_stop(root: str) -> dict:
     """SIGTERM on host 1 only -> the stop consensus breaks both hosts at
     the same boundary, the collective final save lands, and a fresh job
-    restores it bit-exact."""
+    restores it bit-exact.
+
+    Protocol replay (ISSUE 14): phase A runs with DCGAN_PROTOCOL_LOG
+    armed, so every real stop-consensus allgather logs its logical op;
+    both processes' logged sequences must be identical AND equal to the
+    committed simulator schedule for this exact scenario
+    (analysis/protocol.lock.jsonl, drill-defaults/sigterm@p1@3) — the
+    proof the simulated trainer mirror and the live trainer issue the
+    same collective stream."""
     common = dict(checkpoint_dir=os.path.join(root, "ck"),
                   sample_dir=os.path.join(root, "sm"))
+    sched = os.path.join(root, "sched.log")
     results = _run_mh_train(common, max_steps=6,
-                            chaos={"1": {"sigterm_at_step": 3}})
+                            chaos={"1": {"sigterm_at_step": 3}},
+                            env_common={"DCGAN_PROTOCOL_LOG": sched})
     for pid, (rc, out) in enumerate(results):
         _check(rc == 0, f"process {pid} failed (rc={rc}): {out[-800:]}")
         _check("TRAIN_DONE step=3" in out,
@@ -760,6 +775,24 @@ def scenario_mh_sigterm_stop(root: str) -> dict:
            "no collective final checkpoint at the stop step")
     saved_sum = next(line for line in chief_out.splitlines()
                      if line.startswith("STATE_SUM="))
+
+    # replay: live collective sequence == committed simulator schedule
+    from dcgan_tpu.analysis import protocol as protocol_lib
+
+    logs = []
+    for pid in range(2):
+        path = f"{sched}.{pid}"
+        _check(os.path.exists(path),
+               f"process {pid} logged no collective sequence at {path}")
+        with open(path, encoding="utf-8") as f:
+            logs.append([ln.strip() for ln in f if ln.strip()])
+    _check(logs[0] == logs[1],
+           f"per-process collective logs diverged: {logs[0]} vs {logs[1]}")
+    expected = protocol_lib.drill_replay_ops()
+    _check(logs[0] == expected,
+           f"live collective sequence {logs[0]} != the committed "
+           f"simulator schedule {expected} — the trainer's boundary "
+           "protocol and analysis/simulate.py's mirror drifted apart")
 
     # phase B: resume lands exactly on the stop step -> the printed state
     # is the restored checkpoint, byte-for-byte the state phase A saved
@@ -777,7 +810,8 @@ def scenario_mh_sigterm_stop(root: str) -> dict:
     _check(restored_sum == saved_sum,
            f"resume is not bit-exact: saved {saved_sum}, restored "
            f"{restored_sum}")
-    return {"stopped_at": 3, "resumed": True, "state_sum": saved_sum}
+    return {"stopped_at": 3, "resumed": True, "state_sum": saved_sum,
+            "replayed_collectives": len(logs[0])}
 
 
 def scenario_mh_watchdog(root: str) -> dict:
