@@ -202,6 +202,139 @@ def _bench_zero_ab(cfg, mesh, n_chips: int, images, base) -> None:
     }))
 
 
+def _bench_progressive_ab(cfg, mesh, n_chips: int, base) -> None:
+    """PROGRESSIVE=1: the progressive-resolution A/B rows (ISSUE 15).
+
+    Two extra BENCH-style rows, both printed BEFORE the headline row so
+    the driver's last-line parse is unchanged:
+
+    1. the schedule A/B — the SAME model trained 64-only vs as a
+       64 -> 128 schedule driven through the shipped PhaseRuntime
+       (surface build, state carry, the lot), with per-phase ms_per_step
+       and the measured switch_ms. The contract: phase-0 throughput ==
+       the fixed-resolution arm within noise (the schedule machinery is
+       free until a switch), and switch_ms is a one-off cost, not a
+       per-step tax.
+    2. a standalone 256px single-phase row — the perf story finally
+       covers more than one shape (ROADMAP item 5). BENCH_256_BATCH
+       overrides its per-chip batch (default: the headline batch).
+    """
+    import dataclasses
+
+    import jax
+
+    from dcgan_tpu.progressive import PhaseRuntime, parse_schedule
+
+    steps = max(1, int(os.environ.get("BENCH_PROGRESSIVE_STEPS",
+                                      min(STEPS_MEASURE, 40))))
+    windows = int(os.environ.get("BENCH_WINDOWS", 3))
+    base_res = cfg.model.output_size
+    top_res = base_res * 2
+    spec = f"{base_res}:{steps},{top_res}:*"
+    cfg_p = dataclasses.replace(
+        cfg, progressive=spec,
+        model=dataclasses.replace(cfg.model, output_size=top_res))
+    rt = PhaseRuntime(
+        cfg_p, mesh,
+        parse_schedule(spec, model=cfg_p.model,
+                       batch_size=cfg_p.batch_size,
+                       max_steps=cfg_p.max_steps,
+                       grad_accum=cfg_p.grad_accum),
+        cfg_p.max_steps)
+
+    rng = np.random.default_rng(7)
+
+    def _imgs(res, batch):
+        import jax.numpy as jnp
+
+        return jnp.asarray(rng.uniform(
+            -1, 1, size=(batch, res, res, cfg.model.c_dim))
+            .astype(np.float32))
+
+    def _arm(pt_i, st, images, tag):
+        def run(st, step_idx, _pt=pt_i, _img=images):
+            for _ in range(steps):
+                st, metrics = _pt.step(st, _img,
+                                       jax.random.fold_in(base, step_idx))
+                step_idx += 1
+            return st, metrics, step_idx
+        st, _m, _idx, dt = _time_arm(run, st, 0, windows)
+        return st, {
+            "ms_per_step": round(dt / steps * 1e3, 3),
+            "images_per_sec_chip": round(
+                cfg.batch_size * steps / dt / n_chips, 1),
+        }
+
+    arms = {}
+    # fixed-resolution control: its own init, the phase-0 config alone
+    _cfg0, pt0 = rt.surface(0)
+    st = pt0.init(jax.random.key(0))
+    st, arms[f"fixed{base_res}"] = _arm(pt0, st, _imgs(base_res,
+                                                      cfg.batch_size),
+                                        "fixed")
+    del st
+    # the scheduled run: phase 0, the live switch, phase 1
+    st = pt0.init(jax.random.key(0))
+    st, arms[f"phase_r{base_res}"] = _arm(pt0, st,
+                                          _imgs(base_res, cfg.batch_size),
+                                          "p0")
+    t_sw = time.perf_counter()
+    st = rt.advance(st)
+    jax.block_until_ready(jax.tree_util.tree_leaves(st)[0])
+    switch_ms = (time.perf_counter() - t_sw) * 1e3
+    _cfg1, pt1 = rt.surface(1)
+    st, arms[f"phase_r{top_res}"] = _arm(pt1, st,
+                                         _imgs(top_res, cfg.batch_size),
+                                         "p1")
+    del st
+    arch = os.environ.get("BENCH_PRESET", "") or f"DCGAN-{base_res}"
+    f0 = arms[f"fixed{base_res}"]
+    p1 = arms[f"phase_r{top_res}"]
+    print(json.dumps({
+        "metric": f"{arch} progressive {base_res}->{top_res} A/B "
+                  f"(batch {BATCH}/chip, per-step dispatch, bf16)",
+        "value": p1["images_per_sec_chip"],
+        "unit": "images/sec/chip",
+        "vs_baseline": None,  # cross-resolution rates have no 64px baseline
+        **arms,
+        "switch_ms": round(switch_ms, 1),
+        "carried_leaves": rt.last_carried,
+    }))
+
+    # standalone 256px single-phase row (the new shape in the perf story)
+    res = 256
+    b256 = int(os.environ.get("BENCH_256_BATCH", BATCH)) * n_chips
+    steps256 = max(1, int(os.environ.get("BENCH_256_STEPS",
+                                         min(STEPS_MEASURE, 20))))
+    from dcgan_tpu.parallel import make_parallel_train
+
+    cfg256 = dataclasses.replace(
+        cfg, batch_size=b256, progressive="",
+        model=dataclasses.replace(cfg.model, output_size=res))
+    pt256 = make_parallel_train(cfg256, mesh)
+    st = pt256.init(jax.random.key(0))
+    img256 = _imgs(res, b256)
+
+    def run256(st, step_idx):
+        for _ in range(steps256):
+            st, metrics = pt256.step(st, img256,
+                                     jax.random.fold_in(base, step_idx))
+            step_idx += 1
+        return st, metrics, step_idx
+
+    st, _m, _idx, dt = _time_arm(run256, st, 0, windows)
+    print(json.dumps({
+        "metric": f"DCGAN-{res} train throughput "
+                  f"(batch {b256 // n_chips}/chip, bf16)",
+        "value": round(b256 * steps256 / dt / n_chips, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,  # the adopted V100 baseline is a 64px number
+        "ms_per_step": round(dt / steps256 * 1e3, 3),
+        "peak_state_mib": _state_mib_per_chip(st),
+    }))
+    del st
+
+
 def _bench_pipeline_ab(cfg, pt, n_chips: int, images, base) -> None:
     """PIPELINE_GD=1: the pipelined G/D dispatch A/B row (ISSUE 7).
 
@@ -498,6 +631,15 @@ def main() -> None:
                   "size > 1", file=sys.stderr)
         else:
             _bench_zero_ab(cfg, mesh, n_chips, images, base)
+    if os.environ.get("PROGRESSIVE") == "1":
+        # the progressive-resolution A/B + 256px rows (ISSUE 15) — printed
+        # before the headline row so the driver's last-line parse holds
+        if cfg.model.attn_res:
+            print("PROGRESSIVE=1 skipped: --progressive does not compose "
+                  "with attention-bearing configs (resolution-anchored "
+                  "site)", file=sys.stderr)
+        else:
+            _bench_progressive_ab(cfg, mesh, n_chips, base)
     if os.environ.get("PIPELINE_GD") == "1":
         # the pipelined G/D A/B row (ISSUE 7) — printed before the headline
         # row so the driver's last-line parse contract is unchanged

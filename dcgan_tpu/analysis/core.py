@@ -140,6 +140,8 @@ class Config:
         "dcgan_tpu/train/coordination.py",
         "dcgan_tpu/serve/server.py",
         "dcgan_tpu/serve/__main__.py",
+        # emits the progressive/* scalar-row extras (ISSUE 15)
+        "dcgan_tpu/progressive/phases.py",
     )
     # DCG001: thread targets that ARE a dispatch thread by design — a
     # subsystem whose single worker owns every collective/program dispatch
@@ -173,6 +175,10 @@ class Config:
         "dcgan_tpu/elastic/",
         "dcgan_tpu/parallel/",
         "dcgan_tpu/evals/",
+        # the progressive switch dispatches mesh programs (per-phase init,
+        # the state-carry copies) at a step-keyed boundary — its decision
+        # code must stay free of host-local-state branches (ISSUE 15)
+        "dcgan_tpu/progressive/",
     )
 
     def load_inventory(self) -> Dict[str, str]:

@@ -33,7 +33,7 @@ CHECK_ID = "DCG004"
 
 #: namespaces that mark a string literal as a metric/JSONL event key
 KEY_NAMESPACES = ("perf", "fleet", "eval", "anomaly", "data", "sample",
-                  "serve", "elastic")
+                  "serve", "elastic", "progressive")
 
 _KEY_RE = re.compile(
     r"^(?:%s)/[A-Za-z0-9_./]+$" % "|".join(KEY_NAMESPACES))

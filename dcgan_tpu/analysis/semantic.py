@@ -168,6 +168,30 @@ def small_config(backend: str = "gspmd", pipeline: bool = False,
         tensorboard=False)
 
 
+def progressive_config(backend: str = "gspmd"):
+    """The canonical progressive schedule the semantic tier enumerates
+    (ISSUE 15): the headline 64 -> 128 -> 256 ladder at the small feature
+    dims, fade armed so the per-phase blend programs join the audit.
+    Every phase's step program is lowered and fingerprinted (`@r64` /
+    `@r128` / `@r256` rows), so the donation audit (DCG007) holds for the
+    grown conv stacks and the warmup-coverage check (DCG009) proves the
+    switch dispatches only planned programs."""
+    from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+
+    return TrainConfig(
+        model=ModelConfig(output_size=256, gf_dim=8, df_dim=8,
+                          compute_dtype="float32"),
+        mesh=MeshConfig(data=CANONICAL_DEVICES),
+        batch_size=8,
+        backend=backend,
+        progressive="64:4,128:4,256:*",
+        progressive_fade_steps=2,
+        sample_every_steps=0,
+        activation_summary_steps=0,
+        nan_check_steps=100,
+        tensorboard=False)
+
+
 @dataclasses.dataclass(frozen=True)
 class ProgramAudit:
     """Everything the checkers need about one lowered program."""
@@ -473,6 +497,50 @@ def enumerate_audits() -> Tuple[List[ProgramAudit], List[CoverageRow]]:
                 f"{backend}::{n}", f, a, path=path,
                 expect_donation=_base(n) in DONATED_PROGRAMS,
                 cadence=cadence))
+
+        # Progressive-resolution variants (ISSUE 15): the canonical
+        # 64->128->256 schedule's per-phase step programs, named @r<res>
+        # (EVERY phase suffixed — the base rows above are a different
+        # model config, so the plain names must not collide). The plan
+        # comes from the same PhaseRuntime the trainer warms, so the
+        # coverage row proves a mid-run switch dispatches only planned
+        # programs; the fade blends (phase > 0, non-donating) are audited
+        # once under gspmd (the program is backend-agnostic).
+        from dcgan_tpu.progressive import PhaseRuntime, parse_schedule
+
+        cfg_pr = progressive_config(backend)
+        rt = PhaseRuntime(
+            cfg_pr, mesh,
+            parse_schedule(cfg_pr.progressive, model=cfg_pr.model,
+                           batch_size=cfg_pr.batch_size,
+                           max_steps=cfg_pr.max_steps,
+                           fade_steps=cfg_pr.progressive_fade_steps),
+            cfg_pr.max_steps,
+            make_pt=lambda c, m: make_parallel_train(c, m))
+        plan_pr = rt.build_warmup_plan(warmup.state_example(rt.pt))
+        coverage.append(CoverageRow(
+            variant=f"{backend}+progressive", path=path,
+            programs=frozenset(rt.pt.programs),
+            plan=tuple(n for n, _, _ in plan_pr),
+            must_cover=frozenset(
+                {"train_step", "init@r128", "train_step@r128",
+                 "state_copy@r128", "fade@r128", "init@r256",
+                 "train_step@r256", "state_copy@r256", "fade@r256"})))
+        res0 = rt.schedule.phases[0].resolution
+        for n, f, a in plan_pr:
+            base_n = _base(n)
+            if base_n not in ("train_step", "fade"):
+                continue
+            if base_n == "fade" and backend != "gspmd":
+                continue
+            nm = n if "@" in n else f"{n}@r{res0}"
+            audits.append(audit_callable(
+                f"{backend}::{nm}", f, a, path=path,
+                expect_donation=base_n in DONATED_PROGRAMS,
+                cadence=f"every step of its phase under `--progressive "
+                        f"\"64:N,128:N,256:*\"`" if base_n == "train_step"
+                        else "per-step inside a fade window "
+                             "(`--progressive_fade_steps`)"))
 
         if backend == "gspmd":
             # the serving plane's rungs: the checkpoint-source sampler at

@@ -120,6 +120,14 @@ class Knobs:
     restore: str = "none"              # none|same|mesh|procs — which saved
                                        # topology the run "resumes" from
                                        # (sidecar.restore_decision input)
+    progressive_switch_at: int = 0     # >0: a progressive-resolution
+                                       # phase switch at this boundary
+                                       # (ISSUE 15) — pending flush,
+                                       # services/pipeline drains, state
+                                       # carry, loader re-bucket, fresh
+                                       # rollback snapshot; all step-keyed
+                                       # and host-local, so the audited
+                                       # schedules must stay symmetric
 
     def to_json(self) -> Dict[str, object]:
         d = dataclasses.asdict(self)
@@ -561,6 +569,7 @@ def _virtual_trainer(mesh: VirtualMesh, pid: int, knobs: Knobs,
 
     primed = False
     pending: Optional[dict] = None
+    phase_idx = 0   # progressive phase (0 = first/only; the switch bumps)
 
     def _gate(rec: dict, *, force: bool = False) -> None:
         """_nan_gate's protocol skeleton: cadence/force keying, the
@@ -611,6 +620,36 @@ def _virtual_trainer(mesh: VirtualMesh, pid: int, knobs: Knobs,
             if knobs.pipeline_gd and primed:
                 mesh.local("pipeline-drain:coordinated-stop")
             break
+        # progressive phase switch (ISSUE 15, trainer's phase-boundary
+        # step): a pure function of step_num and the schedule — every
+        # process takes it at the same boundary with ZERO extra
+        # transports. Mirror order: lag-by-one flush (its gate may trip
+        # and roll back BEHIND the boundary, re-evaluating the switch) ->
+        # services drain -> pipeline drain -> state carry onto the next
+        # phase's surface (the per-phase init + identity copies are mesh
+        # programs, recorded as one swap collective) -> loader re-bucket
+        # -> fresh rollback snapshot of the NEW tree.
+        if knobs.progressive_switch_at and phase_idx == 0 \
+                and step_num >= knobs.progressive_switch_at:
+            if pending is not None:
+                prev, pending = pending, None
+                try:
+                    _gate(prev)
+                except FloatingPointError as e:
+                    if rollback is None:
+                        raise
+                    _do_rollback(e)
+                    continue
+            mesh.local("services-drain:phase-switch")
+            if knobs.pipeline_gd and primed:
+                mesh.local("pipeline-drain:phase-switch")
+                primed = False
+            with mesh.phase(f"phase-switch@{step_num}"):
+                mesh.collective("prog", f"phase_carry@{step_num}")
+            mesh.local("rebucket:phase-switch")
+            phase_idx = 1
+            if rollback is not None:
+                rollback.snapshot(step_num, state)
         # chaos.maybe_hang: this process goes silent inside the guarded
         # dispatch window; peers block in the next collective
         if plan and plan.hang_at_step \
@@ -619,8 +658,11 @@ def _virtual_trainer(mesh: VirtualMesh, pid: int, knobs: Knobs,
             mesh.hang(f"hang@{step_num}")
         # step dispatch: SPMD programs are mesh-synchronous — the
         # schedule entry names which program the stream runs (the ZeRO
-        # stage changes its collective content, DESIGN §6i)
+        # stage changes its collective content, DESIGN §6i; a progressive
+        # run's stream switches to the new phase's programs)
         zs = f"@zero{knobs.zero_stage}" if knobs.zero_stage > 1 else ""
+        if knobs.progressive_switch_at:
+            zs += f"@phase{phase_idx}"
         if knobs.pipeline_gd:
             if not primed:
                 mesh.collective("prog", f"gen_fakes{zs}@{step_num}")
@@ -646,7 +688,7 @@ def _virtual_trainer(mesh: VirtualMesh, pid: int, knobs: Knobs,
         # fleet health cadence (dispatch thread, new_step keyed)
         if knobs.fleet_health_steps \
                 and new_step % knobs.fleet_health_steps == 0:
-            vec = np.asarray([new_step, 0, 0, 0, 0, 0, 0], np.float32)
+            vec = np.asarray([new_step, 0, 0, 0, 0, 0, 0, 0], np.float32)
             with mesh.phase(f"fleet_health@{new_step}"):
                 coordination.fleet_health_gather(vec)
         # snapshot-certify (trainer: forced gate + early lag-by-one
@@ -775,6 +817,15 @@ def configs() -> List[Knobs]:
         Knobs("local-stop", coord_stop=False),
         Knobs("single-proc", n_proc=1, total_steps=5,
               nan_policy="rollback", nan_check_steps=1),
+        # progressive phase switch at a boundary (ISSUE 15): the
+        # drain->carry->rebucket->snapshot sequence must be symmetric
+        # across hosts, including a NaN tripping right AFTER the switch
+        # (rollback restores the post-switch snapshot) and inside the
+        # pre-switch pending flush (rollback behind the boundary, switch
+        # re-evaluates)
+        Knobs("progressive-switch", nan_policy="rollback",
+              nan_check_steps=1, progressive_switch_at=3,
+              pipeline_gd=True, aot_warmup=True),
     ]
 
 
@@ -830,6 +881,14 @@ def faults_for(k: Knobs) -> List[Fault]:
         if k.name == "drill-defaults":
             out.append(F(f"sigterm@p1@{k.total_steps - 1}",
                          {1: {"sigterm_at_step": k.total_steps - 1}}))
+    if k.progressive_switch_at and gate:
+        # the drill scenario's shape: the gate trips at the FIRST step
+        # after the phase switch — rollback must restore the post-switch
+        # snapshot, on every host
+        s = k.progressive_switch_at + 1
+        out.append(F(f"nan@p0@{s}", {0: {"nan_at_step": s}}))
+        if k.n_proc > 1:
+            out.append(F(f"nan@p1@{s}", {1: {"nan_at_step": s}}))
     if k.collective_timeout_secs > 0 and k.n_proc > 1:
         out += [
             F("hang@p1@3", {1: {"hang_at_step": 3}}),

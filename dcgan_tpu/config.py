@@ -564,6 +564,39 @@ class TrainConfig:
                                    # stdout logging (the reference's
                                    # every-step line) only reports each
                                    # call's last step
+    progressive: str = ""          # progressive-resolution schedule
+                                   # (ISSUE 15, ROADMAP item 5): a phase
+                                   # table "RES:STEPS[,...],RES:*" — e.g.
+                                   # "64:2000,128:2000,256:*" — making
+                                   # resolution a scheduled training
+                                   # dimension. Resolutions must be
+                                   # ascending model-stack sites ending at
+                                   # model.output_size (the base config
+                                   # describes the FINAL model); the last
+                                   # phase's '*' runs to max_steps. A
+                                   # third ":BATCH" field per phase
+                                   # shrinks the batch at high res. Phase
+                                   # switches are zero-recompile after
+                                   # --aot_warmup (every phase's programs
+                                   # are pre-lowered AND primed at
+                                   # startup), carry state across the
+                                   # model-surface growth (new-at-phase
+                                   # leaves init fresh, carried leaves
+                                   # transfer), re-open the data pipeline
+                                   # at the new decode resolution, and
+                                   # persist a phase tag in the elastic
+                                   # sidecar so restores resume into the
+                                   # right phase. "" = off (parity)
+    progressive_fade_steps: int = 0  # >0 with --progressive: a linear
+                                   # fade-in over the first N steps of
+                                   # each phase after the first — real
+                                   # images blend alpha*x +
+                                   # (1-alpha)*up(down(x)) through a tiny
+                                   # jitted program (alpha is a traced
+                                   # f32 scalar; one compile per phase),
+                                   # ramping D's real distribution from
+                                   # previous-resolution content to full
+                                   # detail. 0 = hard switches
     pipeline_gd: bool = False      # software-pipelined G/D dispatch
                                    # (ISSUE 7, ParaGAN's separable-stage
                                    # framing): the fused train step is
@@ -761,6 +794,46 @@ class TrainConfig:
                     f"pipeline_gd dispatches per-step stage programs; it "
                     f"does not compose with the scanned multi-step path "
                     f"(steps_per_call={self.steps_per_call} — set it to 1)")
+        if self.progressive_fade_steps < 0:
+            raise ValueError(
+                f"progressive_fade_steps must be >= 0, got "
+                f"{self.progressive_fade_steps}")
+        if self.progressive_fade_steps and not self.progressive:
+            raise ValueError(
+                "progressive_fade_steps > 0 without --progressive is a "
+                "silent no-op — set a --progressive schedule to fade into")
+        if self.progressive:
+            if self.model.attn_res:
+                raise ValueError(
+                    "--progressive does not compose with attn_res: the "
+                    "attention site is anchored to one feature-map "
+                    "resolution, which earlier phases may not contain "
+                    "(and carrying attention projections across a stage "
+                    "shift is undefined)")
+            if self.fid_every_steps:
+                raise ValueError(
+                    "--progressive does not compose with fid_every_steps: "
+                    "the probe's feature extractor and real-side "
+                    "statistics are fixed-resolution; score offline per "
+                    "phase via the evals CLI instead")
+            if self.nan_policy == "rollback" \
+                    and self.rollback_lr_backoff < 1.0:
+                raise ValueError(
+                    "--progressive does not compose with "
+                    "rollback_lr_backoff < 1.0: the pre-warmed backoff "
+                    "surface is per-phase and a mid-schedule rebuild "
+                    "would recompile under the zero-recompile contract; "
+                    "use rollback without LR backoff")
+            # parse (and thereby validate) the schedule at construction —
+            # the trainer re-parses against the live mesh for granule
+            # checks; lazy import mirrors the parse_policy pattern above
+            from dcgan_tpu.progressive.schedule import parse_schedule
+            parse_schedule(self.progressive, model=self.model,
+                           batch_size=self.batch_size,
+                           max_steps=self.max_steps,
+                           steps_per_call=self.steps_per_call,
+                           grad_accum=self.grad_accum,
+                           fade_steps=self.progressive_fade_steps)
         if self.prefetch_device_batches < 0:
             raise ValueError(
                 f"prefetch_device_batches must be >= 0, got "
@@ -881,6 +954,33 @@ def add_model_override_flags(p) -> None:
                         "([K, C] per-class BN tables in G)")
 
 
+def _progressive_checkpoint_resolution(checkpoint_dir: str) -> Optional[int]:
+    """The resolution tag of the NEWEST checkpoint sidecar carrying a
+    progressive phase tag (ISSUE 15), or None. A run stopped mid-schedule
+    saved a SHALLOWER tree than the config.json's final architecture —
+    checkpoint consumers must build their restore template at the saved
+    phase's resolution, not the schedule's end state."""
+    import glob
+    import re
+
+    best: Optional[Tuple[int, int]] = None  # (step, resolution)
+    for path in glob.glob(os.path.join(checkpoint_dir, "integrity",
+                                       "*.sharding.json")):
+        m = re.match(r"(\d+)\.sharding\.json$", os.path.basename(path))
+        if m is None:
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                tag = json.load(f).get("progressive")
+            res = int(tag["resolution"])
+        except (OSError, ValueError, KeyError, TypeError):
+            continue
+        step = int(m.group(1))
+        if best is None or step > best[0]:
+            best = (step, res)
+    return None if best is None else best[1]
+
+
 def resolve_model_config(checkpoint_dir: str, *, preset: Optional[str] = None,
                          overrides: Optional[Dict[str, Any]] = None
                          ) -> ModelConfig:
@@ -889,6 +989,13 @@ def resolve_model_config(checkpoint_dir: str, *, preset: Optional[str] = None,
     Precedence: explicit flag overrides > --preset > the checkpoint's own
     config.json > ModelConfig defaults. `overrides` values of None mean
     "not passed" and are dropped.
+
+    Progressive checkpoints (ISSUE 15): the config.json describes the
+    schedule's FINAL model, but a mid-schedule checkpoint holds an earlier
+    phase's shallower tree — the sidecar's phase tag names which, and the
+    resolved output_size adopts it (an explicit --output_size flag still
+    wins), so `generate --checkpoint_dir` works zero-flag at any point of
+    the schedule instead of failing as an Orbax tree mismatch.
     """
     if preset:
         from dcgan_tpu.presets import get_preset  # lazy: presets imports us
@@ -897,5 +1004,14 @@ def resolve_model_config(checkpoint_dir: str, *, preset: Optional[str] = None,
     else:
         saved = load_config(checkpoint_dir)
         base = saved.model if saved is not None else ModelConfig()
+        if saved is not None and saved.progressive:
+            res = _progressive_checkpoint_resolution(checkpoint_dir)
+            if res is not None and res != base.output_size:
+                print(f"[dcgan_tpu] progressive checkpoint: latest step was "
+                      f"saved at r{res} (schedule "
+                      f"{saved.progressive!r} ends at "
+                      f"r{base.output_size}); building the r{res} model",
+                      file=sys.stderr)
+                base = dataclasses.replace(base, output_size=res)
     given = {k: v for k, v in (overrides or {}).items() if v is not None}
     return dataclasses.replace(base, **given)

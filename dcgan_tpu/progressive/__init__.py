@@ -1,0 +1,44 @@
+"""Progressive-resolution training plane (ISSUE 15, ROADMAP item 5).
+
+Resolution as a scheduled, checkpointable training dimension:
+
+- `schedule.py` — the declarative phase table
+  (`--progressive "64:2000,128:2000,256:*"`), parsed + validated against
+  the model stack, the dispatch granule, and the live mesh; optional
+  linear fade-in alpha per phase.
+- `phases.py` — per-phase `ParallelTrain` surfaces whose programs all
+  join the PR 5 AOT warmup plan up front (`@r<res>` rows) and are
+  PRIMED with one throwaway dispatch each, so a mid-run resolution
+  switch dispatches only already-executed programs (zero compile
+  requests after warmup); cross-phase state carry (new leaves init
+  fresh, carried leaves transfer, elastic reshard path when specs move)
+  and the checkpoint sidecar's phase tag.
+- `rebucket.py` — mid-run data-pipeline re-bucketing: loaders close and
+  re-open at the new decode resolution behind the services drain
+  barrier, with the process-global quarantine tally carried across.
+
+The trainer's phase-boundary step (train/trainer.py) composes these with
+the PR 5 swap mechanics: drain services, drain the G/D pipeline, swap
+surface + loaders, refresh the rollback snapshot, re-arm the watchdog's
+`compiled_ks`. DESIGN.md §6j documents the phase model and the switch
+sequence.
+"""
+
+from dcgan_tpu.progressive.phases import PhaseRuntime, carry_path, carry_state
+from dcgan_tpu.progressive.rebucket import Rebucketer, phase_data_cfg
+from dcgan_tpu.progressive.schedule import (
+    Phase,
+    ProgressiveSchedule,
+    parse_schedule,
+)
+
+__all__ = [
+    "Phase",
+    "PhaseRuntime",
+    "ProgressiveSchedule",
+    "Rebucketer",
+    "carry_path",
+    "carry_state",
+    "parse_schedule",
+    "phase_data_cfg",
+]

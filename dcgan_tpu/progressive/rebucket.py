@@ -1,0 +1,101 @@
+"""Mid-run data-pipeline re-bucketing (ISSUE 15).
+
+A phase switch changes the decode resolution (and possibly the batch),
+which every loader implementation bakes into its threads and buffers at
+construction — so the rebucket move is close-and-reopen, never mutate:
+the trainer drains its services queue (the drain barrier — queued
+telemetry referencing old-phase arrays must land before their buffers
+die), closes the old iterators (stopping the prefetcher/loader threads),
+and opens fresh ones from the phase config. All three loader
+implementations (PythonLoader, tfrecord, native) come along for free
+because re-opening goes through the same `_data_iterator` factory the
+trainer booted with.
+
+Quarantine continuity: the corrupt-record tally (data/quarantine.py) is
+process-global BY DESIGN — it spans loader implementations and
+re-opens — so a phase switch carries it verbatim; the trainer's
+`corrupt_base` delta accounting is untouched and the budget
+(`max_corrupt_records`) keeps bounding the whole RUN, not each phase.
+`Rebucketer.reopen` records the tally at each switch so the invariant is
+observable (and test-pinned).
+
+Real-data runs: the on-disk record size must match each phase's decode
+resolution, so `--data_dir`/`--sample_image_dir` may embed a literal
+`{res}` that resolves per phase (`train_{res}` -> train_64, train_128,
+...; `python -m dcgan_tpu.data.prepare` once per resolution). Dirs
+without the placeholder are used as-is (the manifest check will reject a
+size mismatch loudly). Synthetic runs need nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+RES_PLACEHOLDER = "{res}"
+
+
+def phase_data_cfg(phase_cfg):
+    """The phase config with `{res}` data-dir placeholders resolved to
+    the phase resolution."""
+    res = str(phase_cfg.model.output_size)
+    repl = {}
+    if RES_PLACEHOLDER in phase_cfg.data_dir:
+        repl["data_dir"] = phase_cfg.data_dir.replace(RES_PLACEHOLDER, res)
+    if RES_PLACEHOLDER in phase_cfg.sample_image_dir:
+        repl["sample_image_dir"] = phase_cfg.sample_image_dir.replace(
+            RES_PLACEHOLDER, res)
+    return dataclasses.replace(phase_cfg, **repl) if repl else phase_cfg
+
+
+def close_iterators(*iterators) -> None:
+    """Stop loader/prefetcher threads; None and close-less iterators are
+    fine. Propagates close errors — a loader that cannot release its
+    threads is a real leak, not a cleanup nit."""
+    for it in iterators:
+        if it is not None and hasattr(it, "close"):
+            it.close()
+
+
+class Rebucketer:
+    """Owns the progressive run's (train, sample) iterators across phase
+    switches. `open_fn(phase_cfg) -> (data, sample_data)` is the
+    trainer's factory (its `_data_iterator`/`_sample_data_iterator`
+    closures, pinned to the live mesh); the rebucketer adds the
+    close-before-open ordering and the quarantine-carry bookkeeping."""
+
+    def __init__(self, open_fn: Callable[[Any], Tuple[Iterator,
+                                                      Optional[Iterator]]]):
+        self._open = open_fn
+        self.data: Optional[Iterator] = None
+        self.sample_data: Optional[Iterator] = None
+        self.reopens = 0
+        self.last_tally: int = 0   # quarantine tally at the last (re)open
+
+    def open(self, phase_cfg) -> Tuple[Iterator, Optional[Iterator]]:
+        from dcgan_tpu.data import quarantine
+
+        self.data, self.sample_data = self._open(phase_data_cfg(phase_cfg))
+        self.last_tally = quarantine.count()
+        return self.data, self.sample_data
+
+    def reopen(self, phase_cfg) -> Tuple[Iterator, Optional[Iterator]]:
+        """Close the old phase's loaders, open the new phase's. The
+        process-global quarantine tally rides across untouched (recorded
+        in `last_tally` so the carry is observable); the caller runs the
+        services drain barrier BEFORE calling this."""
+        from dcgan_tpu.data import quarantine
+
+        before = quarantine.count()
+        close_iterators(self.data, self.sample_data)
+        self.data, self.sample_data = self._open(phase_data_cfg(phase_cfg))
+        after = quarantine.count()
+        assert after >= before, \
+            "quarantine tally went backwards across a loader re-open"
+        self.last_tally = after
+        self.reopens += 1
+        return self.data, self.sample_data
+
+    def close(self) -> None:
+        close_iterators(self.data, self.sample_data)
+        self.data = self.sample_data = None

@@ -292,6 +292,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "cross-stage placement (DESIGN.md §6f). "
                         "Sequential update_mode, unconditional models, "
                         "steps_per_call=1 only")
+    p.add_argument("--progressive", default="",
+                   help="progressive-resolution schedule (phase table "
+                        "\"RES:STEPS[:BATCH],...,RES:*\", e.g. "
+                        "\"64:2000,128:2000,256:*\"): train each phase at "
+                        "its resolution and switch mid-run with zero "
+                        "recompiles after --aot_warmup (every phase's "
+                        "programs pre-lowered AND primed at startup). "
+                        "Resolutions ascend to --output_size; state "
+                        "carries across the model growth (new layers init "
+                        "fresh); loaders re-open at each phase's decode "
+                        "resolution ({res} in --data_dir substitutes per "
+                        "phase); the checkpoint sidecar records the phase "
+                        "so resumes land mid-schedule correctly")
+    p.add_argument("--progressive_fade_steps", type=int, default=0,
+                   help=">0 with --progressive: linear fade-in over the "
+                        "first N steps of each later phase (real images "
+                        "blend toward their previous-resolution content; "
+                        "alpha is a traced scalar, one compile per phase)")
     p.add_argument("--steps_per_call", type=int, default=1,
                    help=">1 dispatches K steps as one compiled scan program "
                         "(sheds per-dispatch RPC overhead; observability "
@@ -340,6 +358,8 @@ _FLAG_FIELDS = {
     "lr_schedule": ("", "lr_schedule"), "warmup_steps": ("", "warmup_steps"),
     "steps_per_call": ("", "steps_per_call"),
     "pipeline_gd": ("", "pipeline_gd"),
+    "progressive": ("", "progressive"),
+    "progressive_fade_steps": ("", "progressive_fade_steps"),
     "dataset": ("", "dataset"), "data_dir": ("", "data_dir"),
     "sample_image_dir": ("", "sample_image_dir"),
     "record_dtype": ("", "record_dtype"),

@@ -132,7 +132,7 @@ def _allgather_f32(vec: np.ndarray) -> np.ndarray:
 #: (collective-thread rule: the allgather below is a mesh-wide collective)
 #: and the chief materializes the fleet/* metrics from the gathered table.
 HEALTH_FIELDS = ("step", "step_ms_mean", "host_ms_mean", "queue_depth",
-                 "dropped", "rollbacks", "corrupt_records")
+                 "dropped", "rollbacks", "corrupt_records", "phase")
 
 
 def fleet_health_gather(vec) -> np.ndarray:
@@ -169,6 +169,11 @@ def fleet_metrics(table: np.ndarray) -> Tuple[dict, str]:
         "fleet/dropped_total": float(col["dropped"].sum()),
         "fleet/rollbacks_total": float(col["rollbacks"].sum()),
         "fleet/corrupt_total": float(col["corrupt_records"].sum()),
+        # the active progressive-schedule phase (ISSUE 15; 0 in fixed-
+        # resolution runs). max == min by construction — the switch is
+        # step-keyed, so a fleet split across phases is a protocol bug
+        # worth seeing in the row
+        "fleet/phase": float(col["phase"].max()),
     }
     note = (f"slowest host: process {slowest} "
             f"(step_ms_mean {ms[slowest]:.1f} vs fleet min {ms.min():.1f})")

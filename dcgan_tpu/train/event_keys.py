@@ -85,6 +85,20 @@ EVENT_KEYS: Dict[str, str] = {
     "fleet/rollbacks_total": "fleet_health_steps",
     "fleet/corrupt_total": "fleet_health_steps",
 
+    # -- progressive-resolution schedule (ISSUE 15): the active phase /
+    #    resolution ride every scalar row of a progressive run, alpha
+    #    only inside a fade window, switch_ms once per phase switch.
+    #    Gated on the knob — default (fixed-resolution) streams carry
+    #    none of these (parity-pinned) -------------------------------------
+    "progressive/phase": "progressive schedule",
+    "progressive/resolution": "progressive schedule",
+    "progressive/alpha": "progressive schedule (fade window)",
+    "progressive/switch_ms": "progressive schedule",
+
+    # -- fleet health: the active progressive phase (0 in fixed-resolution
+    #    runs; max across hosts — the switch is step-keyed so max == min) -
+    "fleet/phase": "fleet_health_steps",
+
     # -- probes ----------------------------------------------------------
     "sample/*": "sample_every_steps",
     "eval/fid": "fid_every_steps",

@@ -392,9 +392,33 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
             f"fid_num_samples ({cfg.fid_num_samples}) must divide evenly "
             f"over {jax.process_count()} processes — the in-training probe "
             "splits the sample budget per process (VERDICT r2 #5)")
+    total_steps = max_steps if max_steps is not None else cfg.max_steps
     with startup.phase("init"):
         mesh = make_mesh(cfg.mesh)
-        pt = make_parallel_train(cfg, mesh)
+        # Progressive-resolution schedule (ISSUE 15, DESIGN.md §6j):
+        # resolution becomes a scheduled training dimension — the run is a
+        # sequence of phases, each with its own compiled ParallelTrain
+        # surface over the ONE shared mesh. The runtime owns the phase
+        # table, the per-phase surfaces, and the cross-phase state carry;
+        # pt below always points at the CURRENT phase's surface. None for
+        # fixed-resolution runs — every progressive branch is strictly
+        # opt-in (the parity contract).
+        prog = None
+        if cfg.progressive:
+            from dcgan_tpu.progressive import PhaseRuntime, parse_schedule
+
+            prog = PhaseRuntime(
+                cfg, mesh,
+                parse_schedule(cfg.progressive, model=cfg.model,
+                               batch_size=cfg.batch_size,
+                               max_steps=cfg.max_steps,
+                               steps_per_call=cfg.steps_per_call,
+                               grad_accum=cfg.grad_accum,
+                               fade_steps=cfg.progressive_fade_steps),
+                total_steps, make_pt=make_parallel_train)
+            pt = None  # chosen after the latest checkpoint step is known
+        else:
+            pt = make_parallel_train(cfg, mesh)
     chief = is_chief()
     # Pipelined G/D dispatch (ISSUE 7, DESIGN.md §6f): the step runs as
     # three stage programs with the D step consuming the fake stack
@@ -442,6 +466,32 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                           enabled=chief,
                           tensorboard=cfg.tensorboard)
 
+    # Progressive resume (ISSUE 15): the restore template must be the
+    # phase tree that PRODUCED the latest checkpoint — a boundary-step
+    # save carries the pre-switch tree (the switch below runs before the
+    # first new-phase dispatch), so the schedule-derived phase is
+    # deterministic; the sidecar's phase tag cross-checks it, catching a
+    # --progressive spec edited between runs before Orbax turns it into
+    # an opaque tree mismatch. The tag itself is stamped on every save.
+    if prog is not None:
+        latest = ckpt.latest_step()
+        prog.start(latest)
+        if latest is not None:
+            from dcgan_tpu.elastic import sidecar as _sidecar
+
+            payload = _sidecar.read(cfg.checkpoint_dir, latest) or {}
+            prog.check_resume_tag(payload.get("progressive"), latest)
+        pcfg = prog.cfg
+        pt = prog.pt
+        ckpt.progressive_tag = prog.tag()
+        if chief:
+            print(f"[dcgan_tpu] progressive schedule "
+                  f"{cfg.progressive!r}: starting in phase {prog.index} "
+                  f"(r{prog.resolution}, batch {pcfg.batch_size}, "
+                  f"{prog.n_phases} phase(s) this run)", flush=True)
+    else:
+        pcfg = cfg
+
     with startup.phase("init"):
         state = pt.init(jax.random.key(cfg.seed))
     with startup.phase("restore"):
@@ -484,20 +534,40 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         sample_labels = jax.numpy.arange(sample_z.shape[0]) \
             % cfg.model.num_classes
 
+    rebucketer = None
     with startup.phase("data"):
-        data = _data_iterator(cfg, mesh, synthetic=synthetic_data)
-        # The global-mesh held-out stream feeds the sample-loss probe and,
-        # in single-process runs, the FID probe's real side; the multihost
-        # FID probe streams its own local-mesh iterator instead, so don't
-        # spin a producerless loader for it.
-        sample_data = _sample_data_iterator(
-            cfg, mesh, synthetic=synthetic_data) \
-            if cfg.sample_every_steps or (cfg.fid_every_steps
-                                          and jax.process_count() == 1) \
-            else None
+        if prog is not None:
+            # mid-run re-bucketing (ISSUE 15, progressive/rebucket.py):
+            # the loaders bake decode resolution and batch into their
+            # threads at construction, so each phase switch closes and
+            # re-opens them through this one factory — same iterators the
+            # fixed-resolution path builds, pointed at the phase config
+            # (with {res} data-dir placeholders resolved per phase)
+            from dcgan_tpu.progressive import Rebucketer
+
+            def _open_phase(phase_cfg):
+                d = _data_iterator(phase_cfg, mesh,
+                                   synthetic=synthetic_data)
+                s = _sample_data_iterator(phase_cfg, mesh,
+                                          synthetic=synthetic_data) \
+                    if cfg.sample_every_steps else None
+                return d, s
+            rebucketer = Rebucketer(_open_phase)
+            data, sample_data = rebucketer.open(pcfg)
+        else:
+            data = _data_iterator(cfg, mesh, synthetic=synthetic_data)
+            # The global-mesh held-out stream feeds the sample-loss probe
+            # and, in single-process runs, the FID probe's real side; the
+            # multihost FID probe streams its own local-mesh iterator
+            # instead, so don't spin a producerless loader for it.
+            sample_data = _sample_data_iterator(
+                cfg, mesh, synthetic=synthetic_data) \
+                if cfg.sample_every_steps or (cfg.fid_every_steps
+                                              and jax.process_count() == 1) \
+                else None
     # fixed z for the loss probe, tiled to the probe batch size (the
     # reference feeds the same sample_z every time, image_train.py:77,181)
-    eval_z = jax.numpy.resize(sample_z, (cfg.batch_size, cfg.model.z_dim)) \
+    eval_z = jax.numpy.resize(sample_z, (pcfg.batch_size, cfg.model.z_dim)) \
         if sample_data is not None else None
     base_key = jax.random.key(cfg.seed + 2)
     conditional = cfg.model.num_classes > 0
@@ -598,6 +668,8 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         warmup.cache_serves_all_processes(cfg.compile_cache_per_process)
     pt_backoff = None   # pre-warmed LR-backoff surface for the 1st rollback
     warm_ms: dict = {}
+    warm_base = None    # cache counters at end of warmup: the progressive
+                        # switch prints its compile-request delta from here
     if cfg.aot_warmup:
         if chief and cache_dir is None:
             print("[dcgan_tpu] --aot_warmup without --compile_cache_dir: "
@@ -613,30 +685,57 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                   "--compile_cache_dir to warm the whole fleet)",
                   flush=True)
         with startup.phase("warmup"):
-            plan, pt_backoff = warmup.build_warmup_plan(
-                cfg, pt, state,
-                sample_z=sample_z if cfg.sample_every_steps else None,
-                sample_labels=sample_labels, eval_z=eval_z,
-                make_backoff_pt=(lambda c: make_parallel_train(c, mesh))
-                if cache_fleet_wide else None)
-            warm_ms = warmup.aot_compile(plan)
+            if prog is not None:
+                # progressive warmup (ISSUE 15): EVERY phase's programs
+                # enter the plan up front (@r<res> rows for the other
+                # phases), then each is PRIMED with one throwaway
+                # dispatch — the PR 9 serve-plane mechanism that makes
+                # zero-compile-requests-after-warmup literal, so a
+                # mid-run resolution switch dispatches only
+                # already-executed programs
+                plan = prog.build_warmup_plan(
+                    state,
+                    sample_z=sample_z if cfg.sample_every_steps else None,
+                    sample_labels=sample_labels)
+                warm_ms = warmup.aot_compile(plan)
+                prime_ms = prog.prime(
+                    sample_z=sample_z if cfg.sample_every_steps else None,
+                    sample_labels=sample_labels)
+                if chief:
+                    print("[dcgan_tpu] progressive warmup primed "
+                          + ", ".join(f"{k} {v:.0f}ms"
+                                      for k, v in prime_ms.items()),
+                          flush=True)
+            else:
+                plan, pt_backoff = warmup.build_warmup_plan(
+                    cfg, pt, state,
+                    sample_z=sample_z if cfg.sample_every_steps else None,
+                    sample_labels=sample_labels, eval_z=eval_z,
+                    make_backoff_pt=(lambda c: make_parallel_train(c, mesh))
+                    if cache_fleet_wide else None)
+                warm_ms = warmup.aot_compile(plan)
             # every peer past its compiles before anyone proceeds: the warm
             # proof the watchdog gate needs, and the point where startup
             # skew is paid once instead of surfacing inside guarded windows
             coordination.warmup_barrier()
+        if cache_mon is not None:
+            warm_base = cache_mon.counters()
         if chief:
             print("[dcgan_tpu] aot warmup compiled "
                   + f"{len(warm_ms)} program(s): "
                   + ", ".join(f"{k} {v:.0f}ms"
                               for k, v in warm_ms.items()), flush=True)
-    warm_proof = cfg.aot_warmup and cache_fleet_wide
+    # priming (progressive) warms every process's in-process dispatch
+    # caches directly, so it is warm proof even without a fleet-wide
+    # persistent cache; the plain AOT path still needs cache hits to stick
+    warm_proof = cfg.aot_warmup and (
+        cache_fleet_wide or (prog is not None and prog.primed))
 
-    total_steps = max_steps if max_steps is not None else cfg.max_steps
     start_step = int(jax.device_get(state["step"]))
     t_start = time.time()
     metrics = {}
     timer = StepTimer(window=cfg.timing_window,
-                      images_per_step=cfg.batch_size)
+                      images_per_step=pcfg.batch_size)
 
     # Async host services (train/services.py): every non-step host action —
     # metric materialization, param/activation histograms, sample-grid PNG
@@ -666,6 +765,10 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                      lambda: quarantine.count() - corrupt_base)
     if rollback is not None:
         registry.provide("rollbacks", lambda: rollback.rollbacks)
+    if prog is not None:
+        # flight-recorder records and the fleet health vector both name
+        # the active phase through the one counter surface (ISSUE 15)
+        registry.provide("progressive_phase", lambda: prog.index)
     if cache_mon is not None:
         registry.provide_group(
             ("compile_cache_requests", "compile_cache_hits",
@@ -1015,7 +1118,7 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
             raise
         if chief and cfg.log_every_steps and s % cfg.log_every_steps == 0:
             m = _host_vals(p)
-            epoch = s * cfg.batch_size // epoch_size
+            epoch = s * pcfg.batch_size // epoch_size
             print(f"[dcgan_tpu] epoch {epoch} step {s} "
                   f"time {time.time() - t_start:.1f}s "
                   f"d_loss {m['d_loss']:.4f} g_loss {m['g_loss']:.4f}")
@@ -1024,7 +1127,8 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         # never forces a readback of its own
         _flight_record(p, "ok" if gated else "")
         if p["write_scalars"]:
-            row = {**_host_vals(p), **timer.summary(), **_health_extras()}
+            row = {**_host_vals(p), **timer.summary(), **_health_extras(),
+                   **(prog.scalar_extras(s) if prog is not None else {})}
             svc.submit(lambda: writer.write_scalars(s, row), tag="scalars")
 
     # one step's metrics record awaiting its lag-by-one consumption
@@ -1114,7 +1218,17 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
     # step_num is tracked on the host (it equals state["step"], which the
     # trainer fully determines) — touching the device array every iteration
     # would force a per-step host sync and serialize the pipeline.
-    epoch_size = max(1, _epoch_size(cfg))  # hoisted: reads the manifest once
+    # hoisted: reads the manifest once per phase; progressive runs resolve
+    # the {res} data-dir placeholder so the epoch counter reads the REAL
+    # phase manifest, and the switch below re-reads it for the next phase
+    def _phase_epoch_size() -> int:
+        if prog is None:
+            return max(1, _epoch_size(cfg))
+        from dcgan_tpu.progressive import phase_data_cfg
+
+        return max(1, _epoch_size(phase_data_cfg(pcfg)))
+
+    epoch_size = _phase_epoch_size()
     step_num = start_step
     # call shapes (steps_per_call k values) already dispatched against the
     # CURRENT `pt` — the watchdog only arms dispatch windows for these;
@@ -1188,6 +1302,67 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 # mid-write relative to the state that was saved
                 svc.drain()
                 break
+            # Phase boundary (ISSUE 15, DESIGN.md §6j): the switch decision
+            # is a pure function of step_num and the schedule, so every
+            # process takes it at the same boundary with zero extra
+            # collectives (the protocol tier's progressive config pins the
+            # symmetry). Sequence: flush the lag-by-one record (old-phase
+            # metrics; a trip here rolls back BEHIND the boundary and the
+            # switch re-evaluates) -> services drain barrier (queued
+            # telemetry referencing old-phase arrays lands before their
+            # buffers die) -> G/D pipeline drain -> state carry onto the
+            # next phase's surface -> loader re-bucket -> fresh rollback
+            # snapshot (a NaN right after the switch must restore the NEW
+            # tree) -> watchdog compiled_ks re-armed for the new surface.
+            # With --aot_warmup every dispatched program was primed at
+            # startup, so the whole switch issues zero compile requests
+            # (the printed delta, CompileCacheMonitor-pinned).
+            if prog is not None and prog.switch_due(step_num):
+                if pending is not None:
+                    prev, pending = pending, None
+                    if not _consume_or_rollback(prev):
+                        continue
+                t_sw = time.perf_counter()
+                svc.drain()
+                if pipeline is not None:
+                    with _guard("pipeline-drain", step_num):
+                        pipeline.drain("phase-switch")
+                old_res = prog.resolution
+                state = prog.advance(state)
+                pt = prog.pt
+                pcfg = prog.cfg
+                ckpt.progressive_tag = prog.tag()
+                data, sample_data = rebucketer.reopen(pcfg)
+                eval_z = jax.numpy.resize(
+                    sample_z, (pcfg.batch_size, cfg.model.z_dim)) \
+                    if sample_data is not None else None
+                timer = StepTimer(window=cfg.timing_window,
+                                  images_per_step=pcfg.batch_size)
+                epoch_size = _phase_epoch_size()
+                compiled_ks.clear()
+                if prog.primed:
+                    compiled_ks.add(1)
+                    if cfg.steps_per_call > 1:
+                        compiled_ks.add(cfg.steps_per_call)
+                if rollback is not None:
+                    rollback.snapshot(step_num, state)
+                switch_ms = (time.perf_counter() - t_sw) * 1e3
+                note = ""
+                if cache_mon is not None and warm_base is not None:
+                    d = warmup.CompileCacheMonitor.delta(
+                        cache_mon.counters(), warm_base)
+                    note = f" compile_requests_delta={int(d['requests'])}"
+                if chief:
+                    print(f"[dcgan_tpu] progressive phase {prog.index} at "
+                          f"step {step_num}: r{old_res} -> "
+                          f"r{prog.resolution} (batch {pcfg.batch_size}, "
+                          f"{prog.last_carried} leaves carried) "
+                          f"switch_ms={switch_ms:.1f}{note}", flush=True)
+                    srow = {**prog.scalar_extras(step_num + 1),
+                            "progressive/switch_ms": switch_ms}
+                    svc.submit(lambda s=step_num, r=srow:
+                               writer.write_scalars(s, r),
+                               tag="progressive")
             # steps_per_call > 1: dispatch K steps as one scanned program
             # when aligned to a K boundary with K steps remaining (a
             # checkpoint restore can land mid-boundary; single steps
@@ -1225,6 +1400,8 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 key = jax.random.fold_in(base_key, step_num)
                 if conditional:
                     images, labels = next(data)
+                    if prog is not None:
+                        images = prog.fade_images(images, step_num)
                     state, metrics = pt.step(state, images, key, labels)
                 elif pipeline is not None:
                     # pipelined dispatch (ISSUE 7): d_update consumes the
@@ -1234,9 +1411,18 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                     # watchdog phase armed above names which case a hang
                     # died in
                     images = next(data)
+                    if prog is not None:
+                        # image-space fade-in (ISSUE 15): inside a fade
+                        # window the real batch blends toward its
+                        # previous-resolution content through the phase's
+                        # jitted blend (alpha a traced scalar); a no-op
+                        # dispatch-free identity at alpha == 1
+                        images = prog.fade_images(images, step_num)
                     state, metrics = pipeline.step(pt, state, images, key)
                 else:
                     images = next(data)
+                    if prog is not None:
+                        images = prog.fade_images(images, step_num)
                     state, metrics = pt.step(state, images, key)
             else:
                 # one vmapped dispatch for all K per-step keys (a python
@@ -1299,7 +1485,9 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                     cur["write_scalars"] = True  # written at the next flush
                 else:
                     row = {**_host_vals(cur), **timer.summary(),
-                           **_health_extras()}
+                           **_health_extras(),
+                           **(prog.scalar_extras(new_step)
+                              if prog is not None else {})}
                     svc.submit(lambda s=new_step, r=row:
                                writer.write_scalars(s, r), tag="scalars")
                 snap = _snapshot_params(state["params"])
@@ -1326,7 +1514,8 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 vec = np.asarray(
                     [new_step, tsum.get("perf/step_ms_mean", 0.0),
                      tsum.get("perf/host_ms_mean", 0.0), c.services_queue,
-                     c.services_dropped, c.rollbacks, c.corrupt_records],
+                     c.services_dropped, c.rollbacks, c.corrupt_records,
+                     c.progressive_phase],
                     np.float32)
                 with _guard("fleet-health", new_step):
                     table = coordination.fleet_health_gather(vec)

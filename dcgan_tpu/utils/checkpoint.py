@@ -241,6 +241,12 @@ class Checkpointer:
         # sharding sidecars captured at save() time, written (chief-only)
         # once their step finalizes — see _stash_sidecar
         self._pending_sidecars: Dict[int, Dict] = {}
+        # progressive-schedule phase tag (ISSUE 15): the trainer sets this
+        # dict ({"phase": i, "resolution": r}) at start and on every phase
+        # switch; saves fold it into the sharding sidecar so a resume can
+        # cross-check which phase's tree a checkpoint carries. None (the
+        # default) leaves the sidecar schema untouched — parity.
+        self.progressive_tag: Optional[Dict[str, int]] = None
         # checksum-pass parallelism for the fused verified restore; the
         # env override exists for hosts whose storage saturates earlier
         self.verify_threads = max(1, int(os.environ.get(
@@ -279,6 +285,11 @@ class Checkpointer:
 
         payload = _sidecar.build_payload(state)
         if payload is not None:
+            tag = getattr(self, "progressive_tag", None)
+            if tag:
+                # which progressive phase's tree this step carries
+                # (ISSUE 15); key absent in fixed-resolution runs
+                payload["progressive"] = dict(tag)
             self._pending_sidecars[int(step)] = payload
 
     def _write_sidecar(self, step: int, payload: Dict) -> None:

@@ -40,6 +40,13 @@ class CounterSnapshot:
     compile_cache_requests: int = 0
     compile_cache_hits: int = 0
     compile_cache_misses: int = 0
+    progressive_phase: int = 0     # active progressive-schedule phase
+                                   # index (ISSUE 15); 0 in fixed-
+                                   # resolution runs — flight-recorder
+                                   # dumps and the fleet health vector
+                                   # both read it, so a crash dump or a
+                                   # straggler row names the phase it
+                                   # happened in
     # serving plane (ISSUE 9, dcgan_tpu/serve): zero in training runs —
     # the SamplerServer registers these on its own registry instance
     serve_requests: int = 0        # generation requests accepted

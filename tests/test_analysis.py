@@ -382,7 +382,8 @@ class TestKeyInventory:
         cfg = core.Config()
         sources = core.collect_sources(
             [core.default_root() + "/dcgan_tpu/train",
-             core.default_root() + "/dcgan_tpu/serve"],
+             core.default_root() + "/dcgan_tpu/serve",
+             core.default_root() + "/dcgan_tpu/progressive"],
             core.default_root())
         found = set()
         for sf in sources:

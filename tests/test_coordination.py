@@ -269,9 +269,9 @@ class TestFleetHealth:
     tests/test_flight_recorder.py::TestFleetHealthEndToEnd)."""
 
     def _vec(self, step, step_ms, host_ms=1.0, queue=0, dropped=0,
-             rollbacks=0, corrupt=0):
+             rollbacks=0, corrupt=0, phase=0):
         return np.asarray([step, step_ms, host_ms, queue, dropped,
-                           rollbacks, corrupt], np.float32)
+                           rollbacks, corrupt, phase], np.float32)
 
     def test_single_process_gather_is_local_table(self):
         table = coordination.fleet_health_gather(self._vec(4, 12.5))
@@ -287,9 +287,10 @@ class TestFleetHealth:
             return np.stack([np.asarray(vec), np.asarray(vec) * 2])
 
         monkeypatch.setattr(coordination, "_allgather_f32", capture)
+        n = len(coordination.HEALTH_FIELDS)
         table = coordination.fleet_health_gather(self._vec(4, 10.0))
-        assert sent and sent[0].shape == (7,)   # local vector on the wire
-        assert table.shape == (2, 7)
+        assert sent and sent[0].shape == (n,)   # local vector on the wire
+        assert table.shape == (2, n)
         assert table[1, 1] == pytest.approx(20.0)
 
     def test_fleet_metrics_skew_and_slowest_host(self):

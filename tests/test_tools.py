@@ -535,6 +535,42 @@ class TestPipelineRollbackSmoke:
 
 
 @pytest.mark.chaos
+class TestProgressiveSwitchSmoke:
+    """ISSUE 15's tier-1 pin (chaos-marker pattern): a NaN at the first
+    step after a progressive phase switch must roll back to the
+    POST-switch snapshot (the new phase's tree), complete, replay
+    STATE_SUM bit-exactly, and keep the pre-switch phase's losses
+    bit-exact against an unfaulted control — through real trainer
+    subprocesses, inside an explicit runtime budget. The full matrix
+    runs standalone: `JAX_PLATFORMS=cpu python tools/chaos_drill.py`."""
+
+    def test_progressive_switch_within_budget(self):
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "tools/chaos_drill.py", "--only",
+             "progressive-switch"],
+            cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        elapsed = time.monotonic() - t0
+        lines = [json.loads(l) for l in res.stdout.splitlines()
+                 if l.startswith("{")]
+        summary = lines[-1]
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
+        assert summary["scenarios"] == 1 and summary["failed"] == 0
+        scenarios = {p["scenario"]: p for p in lines if "scenario" in p}
+        assert set(scenarios) == {"progressive-switch"}
+        row = scenarios["progressive-switch"]
+        assert row["rollbacks"] >= 1
+        assert row["replay_bit_exact"] is True
+        assert row["preswitch_losses_bit_exact"] is True
+        # three tiny trainer subprocesses (faulted pair + control, each
+        # compiling two phase surfaces); ~4x headroom for CI contention
+        assert elapsed < 300, f"progressive-switch smoke took {elapsed:.0f}s"
+
+
+@pytest.mark.chaos
 class TestZeroRollbackSmoke:
     """ISSUE 13's tier-1 pin (chaos-marker pattern): a NaN fault under
     --zero_stage 3 must restore the data-SHARDED state from the rollback
@@ -604,6 +640,38 @@ class TestElasticShrinkSmoke:
         # cross resume, a 2-proc control pair; ~20 s measured total on a
         # quiet host) — generous headroom for CI contention
         assert elapsed < 300, f"elastic-shrink smoke took {elapsed:.0f}s"
+
+
+@pytest.mark.slow
+class TestBenchProgressiveAB:
+    """ISSUE 15's bench contract: `PROGRESSIVE=1 python bench.py` prints
+    the progressive A/B row (fixed-res arm vs per-phase ms_per_step +
+    switch_ms, driven through the shipped PhaseRuntime) and a standalone
+    256px single-phase row, both BEFORE the headline row. Slow tier:
+    a 256px compile in a subprocess."""
+
+    def test_progressive_rows_before_headline(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_PLATFORM="cpu",
+                   BENCH_BATCH="4", BENCH_STEPS="2", BENCH_WINDOWS="1",
+                   BENCH_DEVSTEP="0", BENCH_SIZE="16", PROGRESSIVE="1",
+                   BENCH_PROGRESSIVE_STEPS="2", BENCH_256_BATCH="2",
+                   BENCH_256_STEPS="1")
+        res = subprocess.run([sys.executable, "bench.py"], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=900)
+        assert res.returncode == 0, (res.stdout[-800:], res.stderr[-800:])
+        rows = [json.loads(l) for l in res.stdout.splitlines()
+                if l.startswith("{")]
+        # both extra rows precede the headline row (last-line parse)
+        ab = next(r for r in rows if "progressive" in r["metric"])
+        r256 = next(r for r in rows if r["metric"].startswith("DCGAN-256"))
+        assert rows.index(ab) < len(rows) - 1
+        assert rows.index(r256) < len(rows) - 1
+        assert ab["switch_ms"] > 0 and ab["carried_leaves"] > 0
+        assert ab["fixed16"]["ms_per_step"] > 0
+        assert ab["phase_r16"]["ms_per_step"] > 0
+        assert ab["phase_r32"]["ms_per_step"] > 0
+        assert r256["ms_per_step"] > 0 and r256["peak_state_mib"] > 0
 
 
 @pytest.mark.slow

@@ -118,9 +118,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 # jax-free import (config never touches jax at module scope): the
-# zero-rollback scenario passes a MeshConfig through the driver's
-# repr-round-tripped `extra` dict
-from dcgan_tpu.config import MeshConfig  # noqa: E402
+# zero-rollback scenario passes a MeshConfig — and progressive-switch a
+# ModelConfig — through the driver's repr-round-tripped `extra` dict
+from dcgan_tpu.config import MeshConfig, ModelConfig  # noqa: E402
 
 # CI subset (tests/test_tools.py pins --smoke into tier-1): the cheapest
 # scenarios that still cross every new layer — quarantine (data), retry
@@ -140,12 +140,12 @@ if os.environ.get("DRILL_THREEFRY_PARTITIONABLE"):
     jax.config.update("jax_threefry_partitionable", True)
 from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
 from dcgan_tpu.train.trainer import train
-base = dict(batch_size=8, tensorboard=False, sample_every_steps=0,
+base = dict(model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                              compute_dtype="float32"),
+            batch_size=8, tensorboard=False, sample_every_steps=0,
             save_summaries_secs=0.0, log_every_steps=1)
 base.update({extra!r})  # scenario overrides WIN over the driver defaults
-cfg = TrainConfig(model=ModelConfig(output_size=16, gf_dim=8, df_dim=8,
-                                    compute_dtype="float32"),
-                  **base)
+cfg = TrainConfig(**base)
 state = train(cfg, synthetic_data={synthetic!r}, max_steps={max_steps!r})
 import numpy as np
 total = sum(float(np.abs(np.asarray(jax.device_get(leaf),
@@ -513,6 +513,61 @@ def scenario_zero_rollback(root: str) -> dict:
             "replay_bit_exact": True, "state_sum": sum_z}
 
 
+def scenario_progressive_switch(root: str) -> dict:
+    """NaN at the step right AFTER a progressive phase switch (ISSUE 15):
+    the rollback must restore the POST-switch snapshot (taken at the
+    boundary, the new phase's tree — restoring the old tree would feed
+    r16 state to r32 programs), the run completes, and determinism holds
+    two ways: the faulted run replays STATE_SUM bit-exactly, and the
+    pre-switch phase's losses are bit-exact against an UNFAULTED control
+    (the rollback re-keys the replayed window by design, so post-rollback
+    steps legitimately diverge from the control — the unpoisoned phase
+    must not)."""
+    model = ModelConfig(output_size=32, gf_dim=8, df_dim=8,
+                        compute_dtype="float32")
+    knobs = dict(model=model, progressive="16:3,32:*",
+                 nan_policy="rollback", nan_check_steps=1,
+                 rollback_snapshot_steps=100,  # only init + switch snapshots
+                 max_rollbacks=2, save_model_secs=1e9)
+    switch_step = 3
+
+    def one(tag, chaos_plan):
+        ck = os.path.join(root, f"ck-{tag}")
+        rc, out = _run_train(
+            dict(checkpoint_dir=ck,
+                 sample_dir=os.path.join(root, f"sm-{tag}"), **knobs),
+            max_steps=6, chaos=chaos_plan)
+        _check(rc == 0, f"{tag}: trainer failed (rc={rc}): {out[-800:]}")
+        _check(f"progressive phase 1 at step {switch_step}: r16 -> r32"
+               in out, f"{tag}: no phase-switch line: {out[-800:]}")
+        _check("TRAIN_DONE step=6" in out,
+               f"{tag}: run did not complete: {out[-400:]}")
+        return _state_sum(out), _loss_rows(_events(ck)), out
+
+    sum_a, loss_a, out_a = one("a", {"nan_at_step": switch_step + 1})
+    _check(f"rolling back to last-good snapshot at step {switch_step}"
+           in out_a,
+           f"rollback did not restore the post-switch snapshot: "
+           f"{out_a[-800:]}")
+    rollbacks = _scalar_values(_events(os.path.join(root, "ck-a")),
+                               "anomaly/rollbacks")
+    _check(rollbacks and max(rollbacks) >= 1,
+           f"anomaly/rollbacks missing (got {rollbacks})")
+    sum_b, _loss_b, _out_b = one("b", {"nan_at_step": switch_step + 1})
+    _check(sum_a == sum_b,
+           f"faulted progressive replay diverged: {sum_a} != {sum_b}")
+    sum_c, loss_c, _out_c = one("control", None)
+    for s in range(1, switch_step + 1):
+        _check(loss_a.get(s) == loss_c.get(s),
+               f"pre-switch phase losses diverged at step {s}: "
+               f"{loss_a.get(s)} != {loss_c.get(s)}")
+    _check(sum_a != sum_c or loss_a == loss_c,
+           "sanity: faulted and control runs are byte-identical yet a "
+           "rollback fired")
+    return {"rollbacks": max(rollbacks), "final_step": 6,
+            "replay_bit_exact": True, "preswitch_losses_bit_exact": True}
+
+
 def scenario_thread_checks(root: str) -> dict:
     """(no fault) a short train under DCGAN_THREAD_CHECKS=1 (ISSUE 8): the
     runtime thread-discipline tripwire wraps every collective entry point
@@ -611,6 +666,7 @@ SCENARIOS = {
     "thread-checks": scenario_thread_checks,
     "pipeline-rollback": scenario_pipeline_rollback,
     "zero-rollback": scenario_zero_rollback,
+    "progressive-switch": scenario_progressive_switch,
     "corrupt-record": scenario_corrupt_record,
     "corrupt-budget": scenario_corrupt_budget,
     "truncate-checkpoint": scenario_truncate_checkpoint,
