@@ -14,7 +14,9 @@ adopt (documented assumption) as baseline=2000 for vs_baseline.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 (PIPELINE_GD=1 prints an extra pipelined-G/D A/B row FIRST — see
-_bench_pipeline_ab — so the headline row stays the last line.)
+_bench_pipeline_ab — so the headline row stays the last line; likewise
+ZERO_STAGE, PROGRESSIVE=1, and the PRECISION / PALLAS_FUSED knobs —
+see _bench_precision_ab.)
 """
 
 from __future__ import annotations
@@ -199,6 +201,97 @@ def _bench_zero_ab(cfg, mesh, n_chips: int, images, base) -> None:
         "state_mib_zero1_over_top": round(
             z1["peak_state_mib"] / ztop["peak_state_mib"], 3)
         if ztop["peak_state_mib"] else None,
+    }))
+
+
+def _bench_precision_ab(cfg, mesh, n_chips: int, images, base) -> None:
+    """PRECISION={bf16,fp8} / PALLAS_FUSED=1: the fused-kernel +
+    reduced-precision A/B row (ISSUE 17).
+
+    Measures the SAME workload per-step against an explicit f32-unfused
+    control arm (precision="f32" forces f32 params+compute even when the
+    headline config computes in bf16), plus one arm per armed knob —
+    @pallas_fused (fused conv⊕BN⊕act Pallas GEMM blocks), @<precision>
+    (the reduced-precision policy), and their composition when both are
+    set. Every arm reports ms_per_step + images_per_sec_chip +
+    peak_state_mib (bf16 params halve the resident param/nu bytes; mu
+    stays f32 master). The acceptance contract rides on
+    `ms_f32_over_best`: the best knobbed arm strictly faster than the
+    f32-unfused control at >=128px. Printed BEFORE the headline row so
+    the driver's last-line parse is unchanged.
+    """
+    import dataclasses
+
+    import jax
+
+    from dcgan_tpu.parallel import make_parallel_train
+
+    precision = os.environ.get("PRECISION", "")
+    fused = os.environ.get("PALLAS_FUSED") == "1"
+    if fused and (cfg.model.arch != "dcgan" or cfg.model.num_classes):
+        print("PALLAS_FUSED=1 skipped: fused blocks are plain-DCGAN "
+              "batch-norm only", file=sys.stderr)
+        fused = False
+    if not (precision or fused):
+        return
+    steps = max(1, int(os.environ.get("BENCH_PRECISION_STEPS",
+                                      min(STEPS_MEASURE, 60))))
+    windows = int(os.environ.get("BENCH_WINDOWS", 3))
+
+    def _variant(prec, fuse):
+        m = cfg.model
+        if fuse:
+            m = dataclasses.replace(m, use_pallas=True, pallas_fused=True)
+        return dataclasses.replace(cfg, model=m, precision=prec)
+
+    arm_cfgs = [("f32", _variant("f32", False))]
+    if fused:
+        arm_cfgs.append(("pallas_fused", _variant("f32", True)))
+    if precision:
+        arm_cfgs.append((precision, _variant(precision, False)))
+    if precision and fused:
+        arm_cfgs.append((f"{precision}+fused", _variant(precision, True)))
+
+    arms = {}
+    for tag, cfg_a in arm_cfgs:
+        pt_a = make_parallel_train(cfg_a, mesh)
+        st = pt_a.init(jax.random.key(0))
+        peak_state = _state_mib_per_chip(st)
+
+        def run(st, step_idx, _pt=pt_a):
+            for _ in range(steps):
+                st, metrics = _pt.step(st, images,
+                                       jax.random.fold_in(base, step_idx))
+                step_idx += 1
+            return st, metrics, step_idx
+
+        st, _metrics, _idx, dt = _time_arm(run, st, 0, windows)
+        arms[tag] = {
+            "ms_per_step": round(dt / steps * 1e3, 3),
+            "images_per_sec_chip": round(
+                cfg.batch_size * steps / dt / n_chips, 1),
+            "peak_state_mib": peak_state,
+        }
+        del st  # free the arm's state before the next arm compiles
+    arch = os.environ.get("BENCH_PRESET", "") or (
+        f"DCGAN-{cfg.model.output_size}")
+    best_tag = min((t for t in arms if t != "f32"),
+                   key=lambda t: arms[t]["ms_per_step"])
+    f32, best = arms["f32"], arms[best_tag]
+    print(json.dumps({
+        "metric": f"{arch} precision/fusion A/B (batch {BATCH}/chip, "
+                  "per-step dispatch)",
+        "value": best["images_per_sec_chip"],
+        "unit": "images/sec/chip",
+        "vs_baseline": round(best["images_per_sec_chip"]
+                             / V100_TF_BASELINE_IMG_PER_SEC, 3),
+        **arms,
+        "best_arm": best_tag,
+        # the headline speed claim as one unitless number: control
+        # ms_per_step over the best knobbed arm's (>1 = knobs won)
+        "ms_f32_over_best": round(
+            f32["ms_per_step"] / best["ms_per_step"], 4)
+        if best["ms_per_step"] else None,
     }))
 
 
@@ -623,6 +716,10 @@ def main() -> None:
         # --zero_stage ladder moves; derived from the live shardings
         "peak_state_mib": _state_mib_per_chip(state),
     }
+    if os.environ.get("PRECISION") or os.environ.get("PALLAS_FUSED") == "1":
+        # the fused-kernel / precision-ladder A/B row (ISSUE 17) — printed
+        # before the headline row so the driver's last-line parse holds
+        _bench_precision_ab(cfg, mesh, n_chips, images, base)
     if os.environ.get("ZERO_STAGE") in ("2", "3"):
         # the ZeRO state-sharding A/B row (ISSUE 13) — printed before the
         # headline row so the driver's last-line parse is unchanged

@@ -75,6 +75,25 @@ class ModelConfig:
                                    # shard_map, ring x flash) but the BN
                                    # kernels' full-channel-vector contract
                                    # does not survive height sharding
+    pallas_fused: bool = False     # fuse each interior G/D stage end-to-end
+                                   # (conv/deconv + bias + BN + act) into the
+                                   # im2col Pallas blocks of
+                                   # ops/pallas_fused.py instead of the
+                                   # XLA-conv + Pallas-BN split. Requires
+                                   # use_pallas (it widens the same routing);
+                                   # dcgan arch only, and cBN layers are
+                                   # excluded (same per-channel-vector
+                                   # contract as bn_pallas). Narrowed to
+                                   # False by the gspmd spatial mesh with
+                                   # bn_pallas (parallel/api.py)
+    quant: str = ""                # "" | "fp8": simulated-quantization
+                                   # (amax-scaled float8_e4m3fn round-trip)
+                                   # of conv/deconv GEMM operands at stages
+                                   # with feature maps >= 64px — the large
+                                   # progressive phases where the MXU fp8
+                                   # path would bite. Normally set via
+                                   # TrainConfig.precision="fp8", not
+                                   # directly
     attn_res: int = 0              # >0 inserts a SAGAN-style self-attention
                                    # block (ops/attention.py) into both stacks
                                    # at the stage whose feature maps are
@@ -122,6 +141,24 @@ class ModelConfig:
                 "bn_pallas=True requires use_pallas=True (bn_pallas only "
                 "narrows the flag; to run the fused BN kernels alone use "
                 "use_pallas=True with attn_res=0)")
+        if self.pallas_fused:
+            if not self.use_pallas:
+                raise ValueError(
+                    "pallas_fused=True requires use_pallas=True (the fused "
+                    "conv blocks ride the same Pallas routing and backend "
+                    "composition guards)")
+            if self.arch != "dcgan":
+                raise ValueError(
+                    "pallas_fused=True supports arch='dcgan' only (the "
+                    "resnet/stylegan stacks have no fused block wired)")
+            if self.conditional_bn:
+                raise ValueError(
+                    "pallas_fused=True is incompatible with conditional_bn "
+                    "(per-example affines break the fused blocks' "
+                    "per-channel-vector contract, same as bn_pallas)")
+        if self.quant not in ("", "fp8"):
+            raise ValueError(
+                f"model.quant must be '' or 'fp8', got {self.quant!r}")
         if self.arch == "stylegan":
             if self.conditional_bn:
                 raise ValueError(
@@ -624,6 +661,28 @@ class TrainConfig:
                                    # update_mode + unconditional models +
                                    # steps_per_call=1 only. False = the
                                    # fused step (reference parity)
+    precision: str = ""            # reduced-precision ladder (ISSUE 17,
+                                   # ROADMAP item 3). "" = leave the model's
+                                   # compute_dtype/param_dtype alone (parity
+                                   # with every prior build). "f32": force
+                                   # float32 compute+params (the A/B
+                                   # reference arm). "bf16": bfloat16 params
+                                   # AND compute end-to-end, with f32 master
+                                   # Adam first moments (make_optimizer sets
+                                   # mu_dtype=float32; nu is a variance —
+                                   # bf16's ~3 significant digits suffice —
+                                   # and BN running stats follow param dtype
+                                   # through batch_norm_init while the
+                                   # moment REDUCTIONS are always f32).
+                                   # "fp8": the bf16 policy plus simulated
+                                   # fp8 quantization of conv GEMM operands
+                                   # at >=64px stages (model.quant="fp8" —
+                                   # the large progressive phases). The
+                                   # policy is applied by normalizing
+                                   # model.{compute,param}_dtype/quant in
+                                   # __post_init__, so every downstream
+                                   # consumer (init, steps, serve, analysis)
+                                   # sees ordinary model dtypes
     backend: str = "gspmd"         # "gspmd": jit + sharding annotations, the
                                    # partitioner inserts collectives
                                    # (parallel/api.py) | "shard_map": explicit
@@ -632,6 +691,32 @@ class TrainConfig:
                                    # DP-only, composes with use_pallas)
 
     def __post_init__(self):
+        if self.precision not in ("", "f32", "bf16", "fp8"):
+            raise ValueError(
+                f"precision must be one of '', 'f32', 'bf16', 'fp8', got "
+                f"{self.precision!r}")
+        if self.precision:
+            # Normalize the policy into the model dtypes up front (frozen
+            # dataclass: object.__setattr__ is the sanctioned escape hatch,
+            # and the rewrite is idempotent so config round-trips through
+            # config_from_dict reproduce the same model). precision OVERRIDES
+            # any explicit model dtype flags — one knob, one meaning.
+            _POLICY = {
+                "f32": ("float32", "float32", ""),
+                "bf16": ("bfloat16", "bfloat16", ""),
+                "fp8": ("bfloat16", "bfloat16", "fp8"),
+            }
+            cdt, pdt, quant = _POLICY[self.precision]
+            if (self.model.compute_dtype, self.model.param_dtype,
+                    self.model.quant) != (cdt, pdt, quant):
+                object.__setattr__(
+                    self, "model",
+                    dataclasses.replace(self.model, compute_dtype=cdt,
+                                        param_dtype=pdt, quant=quant))
+        elif self.model.quant:
+            raise ValueError(
+                "model.quant is set by the precision policy — use "
+                "precision='fp8' rather than setting it directly")
         if self.backend not in ("gspmd", "shard_map"):
             raise ValueError(f"unknown backend {self.backend!r}")
         if self.backend == "shard_map" and (self.mesh.model != 1

@@ -72,6 +72,8 @@ def _get_lib():
             lib.dcgan_loader_error.argtypes = [ctypes.c_void_p]
             lib.dcgan_loader_corrupt_count.restype = ctypes.c_longlong
             lib.dcgan_loader_corrupt_count.argtypes = [ctypes.c_void_p]
+            lib.dcgan_loader_stop.restype = None
+            lib.dcgan_loader_stop.argtypes = [ctypes.c_void_p]
             lib.dcgan_loader_destroy.restype = None
             lib.dcgan_loader_destroy.argtypes = [ctypes.c_void_p]
             _lib = lib
@@ -163,6 +165,15 @@ class NativeLoader:
             if b is None:
                 return
             yield b
+
+    def stop(self):
+        """Halt the loader's worker threads and unblock any `next()` call
+        parked on another thread — WITHOUT freeing the native handle.
+        Callers that drive `next()` from their own thread must stop, join
+        that thread, then `close()`: destroying the handle while a thread
+        is inside `dcgan_loader_next` is a use-after-free."""
+        if getattr(self, "_handle", None):
+            self._lib.dcgan_loader_stop(self._handle)
 
     def close(self):
         if getattr(self, "_handle", None):

@@ -363,6 +363,19 @@ class Loader {
   }
 
   ~Loader() {
+    Stop();
+    for (auto& t : readers_) t.join();
+    batcher_.join();
+  }
+
+  // Halt the worker threads and unblock any Next() caller WITHOUT
+  // releasing the handle. Consumers that drive Next() from their own
+  // thread (data/pipeline.py's DevicePrefetcher) must call this, join
+  // their thread, and only then destroy: deleting the Loader while a
+  // thread is parked in Next()'s condvar wait tears the mutex/cv down
+  // under it — a use-after-free that surfaced as a rare segfault on
+  // prefetcher close.
+  void Stop() {
     {
       std::lock_guard<std::mutex> lk(mu_);
       stop_ = true;
@@ -370,8 +383,6 @@ class Loader {
     pool_cv_.notify_all();
     space_cv_.notify_all();
     batch_cv_.notify_all();
-    for (auto& t : readers_) t.join();
-    batcher_.join();
   }
 
   // 0 = ok; 1 = end of data (non-loop mode); -1 = error (see error()).
@@ -732,6 +743,12 @@ const char* dcgan_loader_error(void* handle) {
 // the final budget-exhausting record once the stream has failed.
 long long dcgan_loader_corrupt_count(void* handle) {
   return static_cast<Loader*>(handle)->corrupt_count();
+}
+
+// Non-destructive stop: unblocks a Next() parked on another thread so the
+// caller can join it before dcgan_loader_destroy (see Loader::Stop).
+void dcgan_loader_stop(void* handle) {
+  static_cast<Loader*>(handle)->Stop();
 }
 
 void dcgan_loader_destroy(void* handle) {

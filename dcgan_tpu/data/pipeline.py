@@ -557,22 +557,33 @@ class DevicePrefetcher:
 
     def close(self) -> None:
         """Stop the producer and release the loader. Mid-epoch safe: any
-        queued device batches are discarded. The owner loader closes
-        BEFORE the join — a producer parked in the loader's untimed batch
-        get() is unblocked by the loader's own close (sentinel put), not
-        by our stop flag, so the reverse order would burn the full join
-        timeout on every slow-source shutdown."""
+        queued device batches are discarded. A producer parked in the
+        loader's untimed batch get() must be unblocked by the loader
+        itself, not our stop flag — but RELEASING the loader while the
+        producer is still inside it is a use-after-free (the native
+        loader's destroy tears the handle down under a thread parked in
+        `dcgan_loader_next`; segfault chased on prefetcher close). So the
+        order is: non-destructive owner `stop()` (unblocks the producer),
+        join, THEN destroy. Owners without a `stop()` (the pure-Python
+        loaders) keep the old unblock path — their `close()` is the
+        sentinel put and frees no native state."""
         self._stop.set()
         try:
             while True:
                 self._queue.get_nowait()
         except queue.Empty:
             pass
-        if self._owner is not None and hasattr(self._owner, "close"):
-            owner, self._owner = self._owner, None
+        owner, self._owner = self._owner, None
+        stop = getattr(owner, "stop", None)
+        if callable(stop):
+            stop()
+        elif owner is not None and hasattr(owner, "close"):
             owner.close()
+            owner = None
         if self._thread.is_alive():
             self._thread.join(timeout=5.0)
+        if owner is not None and hasattr(owner, "close"):
+            owner.close()
 
     def __enter__(self) -> "DevicePrefetcher":
         return self

@@ -101,6 +101,44 @@ PARTITION_RULES: Tuple[Tuple[str, LogicalSpec], ...] = (
 )
 
 
+def count_master_f32_leaves(state: Pytree) -> int:
+    """Census of the reduced-precision ladder's f32 MASTER leaves: Adam
+    first-moment (`.../mu/...`) leaves stored as float32 while their
+    mirrored param leaf is sub-f32 (precision='bf16'/'fp8' sets
+    optax.adam(mu_dtype=f32) — train/steps.py::make_optimizer).
+
+    Master-weight LAYOUT note for the rule table above: mu/nu mirror the
+    param tree by PATH ("opt/<net>/1/0/mu/<leaf>"), and every row keys on
+    the path TAIL — so an f32 master mu shards exactly like its bf16
+    param twin without any precision-specific row. dtype is storage, not
+    placement; the ladder must never add rules here. This count feeds the
+    `perf/precision/master_f32_leaves` metric + CounterSnapshot so a
+    restore/config drift that silently drops the master copy (e.g. a
+    rebuilt optimizer without mu_dtype) is visible in telemetry and
+    pinned by tests.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    params = state.get("params", {})
+    param_dtypes = {
+        path_str(p): jnp.dtype(leaf.dtype)
+        for p, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+    n = 0
+    for p, leaf in jax.tree_util.tree_flatten_with_path(
+            state.get("opt", {}))[0]:
+        path = path_str(p)
+        if "/mu/" not in path:
+            continue
+        net, tail = path.split("/", 1)[0], path.split("/mu/", 1)[1]
+        twin = param_dtypes.get(f"{net}/{tail}")
+        if twin is not None and twin.itemsize < 4 \
+                and jnp.dtype(leaf.dtype) == jnp.float32:
+            n += 1
+    return n
+
+
 def path_str(path: Sequence[Any]) -> str:
     """The "/"-joined coordinate of one tree_flatten_with_path entry —
     DictKey.key / SequenceKey.idx / GetAttrKey.name, in tree order. This

@@ -47,7 +47,8 @@ def export_sampler(checkpoint_dir: str, out_path: str, *,
                    use_ema: bool = False,
                    platforms: Sequence[str] = ("cpu", "tpu"),
                    batch_size: int = 0,
-                   max_serve_batch: int = 64) -> dict:
+                   max_serve_batch: int = 64,
+                   quantize: str = "") -> dict:
     """Bake the checkpoint's generator into a serialized artifact.
 
     batch_size=0 exports a symbolic batch dimension (serve any batch size);
@@ -87,6 +88,17 @@ def export_sampler(checkpoint_dir: str, out_path: str, *,
     step = int(state["step"])
     g_params = state["ema_gen"] if use_ema else state["params"]["gen"]
     bn_gen = state["bn"]["gen"]
+    quant_report = None
+    if quantize == "int8":
+        # serving rung of the precision ladder (ISSUE 17): the baked-in
+        # weights are post-training int8 quantize-dequantized, and the
+        # sidecar records the scheme + measured worst-case weight error so
+        # a served artifact is never silently lossy
+        from dcgan_tpu.serve.quantize import quantize_dequantize_int8
+
+        g_params, quant_report = quantize_dequantize_int8(g_params)
+    elif quantize:
+        raise ValueError(f"quantize must be '' or 'int8', got {quantize!r}")
 
     def sample_fn(z, labels=None):
         return sampler_apply(g_params, bn_gen, z, cfg=mcfg, labels=labels)
@@ -127,6 +139,7 @@ def export_sampler(checkpoint_dir: str, out_path: str, *,
         # --buckets overrides the hint)
         "serving": {
             "source": "ema" if use_ema else "live",
+            **({"quantize": quant_report} if quant_report else {}),
             "bucket_ladder": (
                 [batch_size] if batch_size > 0
                 else list(build_ladder(max_serve_batch).buckets)),
@@ -170,6 +183,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--max_serve_batch", type=int, default=64,
                    help="top rung of the sidecar's serving bucket-ladder "
                         "hint (symbolic-batch artifacts only)")
+    p.add_argument("--quantize", default="", choices=["", "int8"],
+                   help="post-training quantize the baked-in generator "
+                        "weights (int8 symmetric per-channel); the sidecar "
+                        "serving block records scheme + measured error")
     p.add_argument("--preset", default=None,
                    help="named config supplying the architecture instead of "
                         "the checkpoint's config.json")
@@ -191,7 +208,8 @@ def main(argv: Optional[List[str]] = None) -> None:
         args.checkpoint_dir, args.out, preset=args.preset,
         overrides={n: getattr(args, n) for n in MODEL_OVERRIDE_FLAGS},
         use_ema=args.use_ema, platforms=args.platforms,
-        batch_size=args.batch_size, max_serve_batch=args.max_serve_batch)
+        batch_size=args.batch_size, max_serve_batch=args.max_serve_batch,
+        quantize=args.quantize)
     print(f"[dcgan_tpu.export] step-{meta['step']} {meta['weights']} "
           f"sampler ({meta['arch']}, {meta['bytes']} bytes, "
           f"platforms {','.join(meta['platforms'])}) -> {args.out}")

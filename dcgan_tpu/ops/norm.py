@@ -55,6 +55,29 @@ def batch_norm_init(key, num_features: int, *, dtype=jnp.float32,
     return params, state
 
 
+def finish_batch_moments(state: Pytree, mean: jax.Array,
+                         mean_sq: jax.Array, *, momentum: float = 0.9
+                         ) -> Tuple[jax.Array, jax.Array, Pytree]:
+    """The BN train-path arithmetic downstream of the (already cross-shard-
+    reduced) raw moments: E[x^2]-E[x]^2 with the negative-cancellation
+    clamp, and the EMA state update in the stored stat dtype. Shared by
+    `batch_norm_apply` and the fused conv blocks (ops/pallas_fused.py) so
+    the two paths cannot drift. Returns (mean, var, new_state) with
+    mean/var in float32."""
+    mean = mean.astype(jnp.float32)
+    # E[x^2]-E[x]^2 can cancel slightly negative in f32; clamp so
+    # rsqrt(var+eps) can never produce NaN.
+    var = jnp.maximum(mean_sq.astype(jnp.float32) - jnp.square(mean), 0.0)
+    stat_dtype = state["mean"].dtype
+    new_state = {
+        "mean": momentum * state["mean"]
+                + (1.0 - momentum) * mean.astype(stat_dtype),
+        "var": momentum * state["var"]
+               + (1.0 - momentum) * var.astype(stat_dtype),
+    }
+    return mean, var, new_state
+
+
 def _pallas_shard_moments(x: jax.Array, mesh) -> Tuple[jax.Array, jax.Array]:
     """channel_moments per data-shard + pmean — pallas_call is opaque to
     GSPMD (the partitioner would all-gather the batch around it), so under a
@@ -147,16 +170,8 @@ def batch_norm_apply(params: Pytree, state: Pytree, x: jax.Array, *,
         if axis_name is not None:
             mean = lax.pmean(mean, axis_name)
             mean_sq = lax.pmean(mean_sq, axis_name)
-        # E[x^2]-E[x]^2 can cancel slightly negative in f32; clamp so
-        # rsqrt(var+eps) can never produce NaN.
-        var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
-        stat_dtype = state["mean"].dtype
-        new_state = {
-            "mean": momentum * state["mean"]
-                    + (1.0 - momentum) * mean.astype(stat_dtype),
-            "var": momentum * state["var"]
-                   + (1.0 - momentum) * var.astype(stat_dtype),
-        }
+        mean, var, new_state = finish_batch_moments(
+            state, mean, mean_sq, momentum=momentum)
     else:
         mean = state["mean"]
         var = state["var"]

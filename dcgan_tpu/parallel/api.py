@@ -151,8 +151,11 @@ def make_parallel_train(cfg: TrainConfig,
         # BN half of the flag falls back to the jnp path.
         if mesh.shape["model"] > 1 or cfg.mesh.spatial:
             if cfg.mesh.spatial and cfg.model.attn_res:
+                # pallas_fused narrows with bn_pallas: the fused conv blocks
+                # share the BN kernels' full-channel-vector contract, which
+                # height sharding breaks the same way
                 cfg = dataclasses.replace(cfg, model=dataclasses.replace(
-                    cfg.model, bn_pallas=False))
+                    cfg.model, bn_pallas=False, pallas_fused=False))
             else:
                 raise ValueError(
                     "use_pallas under the gspmd backend composes with data-"

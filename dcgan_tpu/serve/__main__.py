@@ -50,6 +50,11 @@ def build_parser() -> argparse.ArgumentParser:
                           "no checkpoint directory needed")
     p.add_argument("--use_ema", action="store_true",
                    help="checkpoint source: serve the EMA generator")
+    p.add_argument("--quantize", default="", choices=["", "int8"],
+                   help="checkpoint source: post-training quantize the "
+                        "served generator weights (int8 symmetric "
+                        "per-channel quantize-dequantize at load; the "
+                        "report rides the warm banner)")
     p.add_argument("--preset", default=None,
                    help="named config supplying the architecture instead "
                         "of the checkpoint's config.json")
@@ -132,7 +137,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         source = CheckpointSource(
             args.checkpoint_dir, use_ema=args.use_ema, preset=args.preset,
             overrides={n: getattr(args, n) for n in MODEL_OVERRIDE_FLAGS},
-            max_batch=args.max_batch)
+            max_batch=args.max_batch, quantize=args.quantize)
     ladder = parse_buckets(args.buckets) if args.buckets else None
     server = SamplerServer(source, ladder=ladder, max_batch=args.max_batch,
                            max_queue=args.max_queue,

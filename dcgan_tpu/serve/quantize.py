@@ -1,0 +1,87 @@
+"""Post-training int8 quantization for the serving path (ISSUE 17).
+
+The serving rung of the reduced-precision ladder: samplers tolerate far
+more quantization than training (no gradient pathways to poison, one
+forward per request), so the EXPORTED/served generator weights get int8
+while training stays on the f32/bf16 ladder — the Gemma-on-TPU serving-
+economics framing (arXiv:2605.25645), scoped serve-only on purpose:
+
+- int8 training would perturb the G/D equilibrium this repo's parity
+  gates pin (BN statistics and Adam moments react to weight noise);
+- serving quality is gated here by a committed max relative-error bound
+  per leaf instead (tests/test_precision.py), and the quantization
+  REPORT rides the server banner / artifact sidecar so an operator can
+  see a quantized fleet is quantized.
+
+Mechanics: symmetric per-output-channel affine (scale = amax/127 over
+each kernel's last axis — output channels for conv/deconv HWIO kernels
+and linear [in, out] weights), quantize-DEquantize at load time. The
+served pytree keeps its original dtypes/shapes, so every downstream
+surface (bucket ladder AOT rows, export, sharding rules) is untouched:
+the rung is a weight TRANSFORM, not a new execution path. True int8
+storage/dispatch would be a lowering follow-up; the quality/economics
+decision is what this rung commits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+Pytree = Any
+
+#: leaves quantized: 2-d+ weight matrices/kernels ("w"). Biases, BN
+#: affines/stats, and SN vectors stay exact — sub-percent of the bytes,
+#: disproportionate quality cost.
+_QUANT_LEAF = "w"
+
+
+def quantize_dequantize_int8(tree: Pytree) -> Tuple[Pytree, dict]:
+    """Returns (tree', report): every eligible weight leaf round-tripped
+    through symmetric per-output-channel int8; report carries the census
+    + worst-case relative error for the banner/sidecar and the committed
+    test bound."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcgan_tpu.elastic.rules import path_str
+
+    flat = jax.tree_util.tree_flatten_with_path(tree)
+    leaves, treedef = flat
+    out = []
+    quantized = 0
+    worst_rel = 0.0
+    worst_path = ""
+    total_bytes = 0
+    quant_bytes = 0
+    for path, leaf in leaves:
+        p = path_str(path)
+        total_bytes += leaf.size * leaf.dtype.itemsize
+        if not (p.endswith("/" + _QUANT_LEAF) or p == _QUANT_LEAF) \
+                or leaf.ndim < 2:
+            out.append(leaf)
+            continue
+        xf = leaf.astype(jnp.float32)
+        # per-output-channel: the last axis of HWIO kernels and [in, out]
+        # linears is the output dim; each channel gets its own amax scale
+        amax = jnp.max(jnp.abs(xf), axis=tuple(range(leaf.ndim - 1)),
+                       keepdims=True)
+        scale = jnp.maximum(amax, 1e-12) / 127.0
+        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+        deq = (q.astype(jnp.float32) * scale).astype(leaf.dtype)
+        denom = max(float(jnp.max(jnp.abs(xf))), 1e-12)
+        rel = float(jnp.max(jnp.abs(deq.astype(jnp.float32) - xf))) / denom
+        if rel > worst_rel:
+            worst_rel, worst_path = rel, p
+        quantized += 1
+        quant_bytes += leaf.size  # 1 byte/elem if stored as int8
+        out.append(deq)
+    tree_out = jax.tree_util.tree_unflatten(treedef, out)
+    report = {
+        "scheme": "int8-sym-per-channel",
+        "quantized_leaves": quantized,
+        "max_rel_error": round(worst_rel, 6),
+        "worst_leaf": worst_path,
+        "int8_bytes": int(quant_bytes),
+        "orig_bytes": int(total_bytes),
+    }
+    return tree_out, report

@@ -41,12 +41,16 @@ class CheckpointSource:
     def __init__(self, checkpoint_dir: str, *, use_ema: bool = False,
                  preset: Optional[str] = None,
                  overrides: Optional[dict] = None,
-                 max_batch: int = 64):
+                 max_batch: int = 64, quantize: str = ""):
+        if quantize not in ("", "int8"):
+            raise ValueError(
+                f"quantize must be '' or 'int8', got {quantize!r}")
         self.checkpoint_dir = checkpoint_dir
         self.use_ema = use_ema
         self.preset = preset
         self.overrides = overrides
         self.max_batch = max_batch
+        self.quantize = quantize
         self.z_dim = 0
         self.num_classes = 0
         self.granule = 1
@@ -78,6 +82,19 @@ class CheckpointSource:
         if restored is None:
             raise FileNotFoundError(
                 f"no checkpoint under {self.checkpoint_dir}")
+        quant_report = None
+        if self.quantize == "int8":
+            # post-training serving rung (ISSUE 17): round-trip BOTH weight
+            # copies through int8 — sample() serves whichever the ema flag
+            # picks, and the two must not silently diverge in fidelity
+            from dcgan_tpu.serve.quantize import quantize_dequantize_int8
+
+            gen_q, quant_report = quantize_dequantize_int8(
+                restored["params"]["gen"])
+            ema_q, _ = quantize_dequantize_int8(restored["ema_gen"])
+            restored = dict(restored)
+            restored["params"] = dict(restored["params"], gen=gen_q)
+            restored["ema_gen"] = ema_q
         self._state = restored
         self.z_dim = mcfg.z_dim
         self.num_classes = mcfg.num_classes
@@ -90,6 +107,8 @@ class CheckpointSource:
         meta = {"source": "checkpoint",
                 "step": int(jax.device_get(restored["step"])),
                 "weights": "ema" if self.use_ema else "live"}
+        if quant_report is not None:
+            meta["quantize"] = quant_report
         if ckpt.last_reshard is not None:
             meta["resharded"] = {
                 "saved_processes": int(

@@ -99,6 +99,15 @@ EVENT_KEYS: Dict[str, str] = {
     #    runs; max across hosts — the switch is step-keyed so max == min) -
     "fleet/phase": "fleet_health_steps",
 
+    # -- reduced-precision ladder (ISSUE 17): one startup row naming the
+    #    active policy (numeric code: 0=f32, 1=bf16, 2=fp8) and the f32
+    #    master-moment census from elastic/rules.py. Gated on the knob —
+    #    precision="" (the default) emits neither, so default streams stay
+    #    byte-identical (parity A/B-pinned); the policy STRING rides the
+    #    flight-recorder header, which is crash-path-only IO ---------------
+    "perf/precision/policy": "precision",
+    "perf/precision/master_f32_leaves": "precision",
+
     # -- probes ----------------------------------------------------------
     "sample/*": "sample_every_steps",
     "eval/fid": "fid_every_steps",

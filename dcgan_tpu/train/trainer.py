@@ -318,9 +318,16 @@ def train(cfg: TrainConfig, *, synthetic_data: bool = False,
         stop.restore()
 
 
-def _flight_context(startup: StartupProfile, flight: FlightRecorder) -> dict:
+def _flight_context(cfg: TrainConfig, startup: StartupProfile,
+                    flight: FlightRecorder) -> dict:
     """Dump-time header context for the crash flight recorder."""
     out = {"process": jax.process_index()}
+    if cfg.precision:
+        # name the active precision policy in every crash dump (ISSUE 17):
+        # a NaN abort under bf16/fp8 must be attributable to the ladder at
+        # a glance. Crash-path-only IO — absent under the default policy,
+        # so this never touches the parity-pinned event stream.
+        out["precision"] = cfg.precision
     if not startup.done:
         # ISSUE 6 satellite: a run that died before its first step ships
         # the startup phases it DID complete (init/restore/warmup so far)
@@ -351,7 +358,7 @@ def _train(cfg: TrainConfig, *, synthetic_data: bool,
     flight = FlightRecorder(
         recorder_path(cfg.checkpoint_dir),
         capacity=cfg.flight_recorder_steps,
-        context=lambda: _flight_context(startup, flight))
+        context=lambda: _flight_context(cfg, startup, flight))
     cache_dir = warmup.configure_compile_cache(
         warmup.resolve_cache_dir(cfg.compile_cache_dir),
         per_process=cfg.compile_cache_per_process)
@@ -775,6 +782,17 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
              "compile_cache_misses"),
             lambda: {"compile_cache_" + k: v
                      for k, v in cache_mon.counters().items()})
+    master_f32 = 0
+    if cfg.precision:
+        # f32 master-moment census (ISSUE 17): counted ONCE at startup —
+        # the optimizer tree's dtype layout is static for the run — and
+        # exposed through the one counter surface so flight-recorder dumps
+        # and the fleet health vector can both see a bf16 run that lost
+        # its master copy (count 0 where the param census says sub-f32)
+        from dcgan_tpu.elastic.rules import count_master_f32_leaves
+
+        master_f32 = count_master_f32_leaves(state)
+        registry.provide("master_f32_leaves", lambda: master_f32)
 
     # Trace capture (ISSUE 6): the scheduled window arms only when
     # --profile_dir was explicitly set (its PR-1 contract); the trigger
@@ -1025,6 +1043,19 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 }
                 svc.submit(lambda s=step, r=erow:
                            writer.write_scalars(s, r), tag="elastic")
+            if cfg.precision:
+                # reduced-precision ladder (ISSUE 17): one row naming the
+                # active policy (numeric code — write_scalars coerces to
+                # float) + the f32 master-moment census. Gated on the knob:
+                # precision="" streams carry neither key (parity contract;
+                # the policy STRING rides the flight-recorder header)
+                prow = {
+                    "perf/precision/policy": float(
+                        {"f32": 0, "bf16": 1, "fp8": 2}[cfg.precision]),
+                    "perf/precision/master_f32_leaves": float(master_f32),
+                }
+                svc.submit(lambda s=step, r=prow:
+                           writer.write_scalars(s, r), tag="precision")
 
     def _health_extras() -> dict:
         """Recovery counters riding the scalar rows — absent until nonzero,

@@ -47,6 +47,13 @@ class CounterSnapshot:
                                    # both read it, so a crash dump or a
                                    # straggler row names the phase it
                                    # happened in
+    master_f32_leaves: int = 0     # f32 Adam master-moment leaves under a
+                                   # reduced-precision policy (ISSUE 17,
+                                   # elastic/rules.py::
+                                   # count_master_f32_leaves); 0 when
+                                   # precision is unset or f32 — a crash
+                                   # dump from a bf16 run that shows 0
+                                   # here means the master copy was lost
     # serving plane (ISSUE 9, dcgan_tpu/serve): zero in training runs —
     # the SamplerServer registers these on its own registry instance
     serve_requests: int = 0        # generation requests accepted

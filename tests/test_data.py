@@ -103,6 +103,37 @@ class TestLoaders:
                 assert -1.0 <= b.min() and b.max() <= 1.0
                 assert b.std() > 0.1  # actually data, not zeros
 
+    def test_stop_terminates_looping_consumer_thread(self, tmp_path):
+        """`stop()` must end a loop=True stream (which never reaches EOF on
+        its own) from another thread WITHOUT freeing the handle, whether the
+        consumer is parked inside `next()` or between calls — the
+        destroy-safety contract DevicePrefetcher relies on
+        (owner.stop -> join -> owner.close)."""
+        import threading
+
+        native = pytest.importorskip("dcgan_tpu.data.native")
+        paths = _write_dataset(tmp_path)
+        ld = native.NativeLoader(paths, **LOADER_KW)
+        first = threading.Event()
+        consumed = []
+
+        def consume():
+            while True:
+                b = ld.next()
+                first.set()
+                if b is None:
+                    return
+                consumed.append(b.shape)
+
+        t = threading.Thread(target=consume)
+        t.start()
+        assert first.wait(timeout=10.0)  # stream is live before the stop
+        ld.stop()
+        t.join(timeout=5.0)
+        assert not t.is_alive()  # next() drained to None — no park, no hang
+        assert all(s == (16, 8, 8, 3) for s in consumed)
+        ld.close()  # safe: the consumer thread is out of the native call
+
     def test_native_large_record_crc_roundtrip(self, tmp_path):
         """64px float64 records (98 KB payloads) exercise the 3-way
         interleaved hardware-CRC path (blocks >= 12 KB) against CRCs written
@@ -629,6 +660,24 @@ class TestDevicePrefetcher:
         assert not isinstance(it2, DevicePrefetcher)
         b2 = next(it2)
         assert b2.shape == (16, 8, 8, 3) and b2.sharding == sh
+
+    def test_close_joins_producer_before_release(self, tmp_path):
+        """Regression: close() used to destroy the native loader while the
+        producer thread could still be inside `dcgan_loader_next` — a
+        use-after-free that segfaulted the whole test process
+        intermittently. The fixed order (owner.stop -> join -> owner.close)
+        must leave the producer joined on every close."""
+        _write_dataset(tmp_path)
+        sh = self._sharding()
+        cfg = DataConfig(data_dir=str(tmp_path / "data"), image_size=8,
+                         batch_size=16, min_after_dequeue=8, n_threads=2,
+                         prefetch_device_batches=2)
+        for _ in range(10):
+            it = make_dataset(cfg, sh)
+            next(it)
+            it.close()
+            assert not it._thread.is_alive()
+            assert it._owner is None
 
     def test_one_epoch_drains_to_stop_iteration(self, tmp_path):
         _write_dataset(tmp_path, n=32, shards=2)

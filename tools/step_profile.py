@@ -39,6 +39,14 @@ gen_fakes / d_update / g_update, with the same scan_trips stamp — so cost
 attribution under --pipeline_gd describes the programs that run, not only
 the fused one.
 
+PALLAS_FUSED=1 / PRECISION={bf16,fp8} (ISSUE 17) profile the knobbed
+program (the fused Pallas conv⊕BN⊕act blocks / the reduced-precision
+policy), and PALLAS_FUSED=1 additionally emits one
+{"component": "fused_kernel/gen/deconv1", ...} row per fused launch —
+analytic flops/bytes/peak_temp_mib from ops/pallas_fused.kernel_cost —
+plus a fused-conservation summary pinning the analytic count against the
+XLA-counted unfused im2col parts.
+
 Workload anchor: the hot loop being replaced, image_train.py:147-194.
 """
 
@@ -102,6 +110,17 @@ def main() -> None:
         # bench_model_config (computed post-override, ADVICE r5 #2); preset
         # labels only need the attn_res marker appended
         profile_of += f"-attn{os.environ['BENCH_ATTN_RES']}"
+    # PRECISION / PALLAS_FUSED compose like bench.py's A/B knobs (ISSUE 17):
+    # the profiled train step IS the knobbed program, and PALLAS_FUSED=1
+    # additionally emits the per-fused-kernel rows below
+    fused = os.environ.get("PALLAS_FUSED") == "1"
+    if fused:
+        cfg = dataclasses.replace(cfg, model=dataclasses.replace(
+            cfg.model, use_pallas=True, pallas_fused=True))
+        profile_of += "-fused"
+    if os.environ.get("PRECISION"):
+        cfg = dataclasses.replace(cfg, precision=os.environ["PRECISION"])
+        profile_of += f"-{cfg.precision}"
     if cfg.model.num_classes:
         raise SystemExit(
             "step_profile does not thread class labels; profile the "
@@ -192,6 +211,76 @@ def main() -> None:
                           _grads_mib(state["params"]["gen"],
                                      state["params"]["disc"]))}),
           flush=True)
+
+    # --- per-fused-kernel rows (ISSUE 17, PALLAS_FUSED=1) ------------------
+    # One row per fused conv⊕BN⊕act launch of a train forward (G + D), from
+    # the analytic model in ops/pallas_fused.py (XLA's cost_analysis cannot
+    # see inside a pallas_call on TPU, and the CPU interpreter lowers the
+    # grid as a loop it counts once). The conservation check is the
+    # independent cross-check: the analytic fused count must equal the
+    # XLA-counted flops of the SAME im2col formulation unfused — the
+    # patches @ w2d GEMM program plus the BN(+act) program the block
+    # replaces. (Not lax.conv's own count: XLA skips multiplies against
+    # padding/dilation zeros, which the materialized patch GEMM — and the
+    # MXU — pay; at small resolutions that bookkeeping difference is >4x,
+    # so it would be the wrong denominator for kernel time. Patch
+    # extraction itself is excluded for the dual reason — it is 0-flop
+    # data movement, but XLA prices its identity-kernel conv lowering as
+    # real multiplies.) GEMM dominates, 2% tolerance covers the
+    # moment/EMA accounting tails on both sides.
+    if fused:
+        from dcgan_tpu.ops.norm import batch_norm_apply, batch_norm_init
+        from dcgan_tpu.ops.pallas_fused import fused_sites, kernel_cost
+
+        def _xla_flops(fn, *args):
+            c = jax.jit(fn).lower(*args).compile()
+            ca = c.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            return ca.get("flops")
+
+        cdt = jnp.dtype(cfg.model.compute_dtype)
+        totals = {"fused": 0, "parts": 0}
+        conserved_all = True
+        for s in fused_sites(cfg.model, BATCH):
+            cost = kernel_cost(s["m"], s["k"], s["c"], train=True,
+                               compute_dtype=cdt)
+            parts_flops = None
+            try:
+                p2d = jax.ShapeDtypeStruct((s["m"], s["k"]), cdt)
+                w2d = jax.ShapeDtypeStruct((s["k"], s["c"]), cdt)
+                bias = jax.ShapeDtypeStruct((s["c"],), cdt)
+                bn_p, bn_s = batch_norm_init(jax.random.key(0), s["c"])
+                u = jax.ShapeDtypeStruct(
+                    (BATCH, s["out_res"], s["out_res"], s["c"]), cdt)
+                parts_flops = _xla_flops(
+                    lambda p, w2, bb: jnp.dot(p, w2) + bb, p2d, w2d, bias) \
+                    + _xla_flops(functools.partial(
+                        batch_norm_apply, train=True, act=s["act"],
+                        leak=cfg.model.leak), bn_p, bn_s, u)
+            except Exception as e:  # platform may not expose cost analysis
+                print(f"{s['name']} unfused cost_analysis unavailable: {e}",
+                      file=sys.stderr)
+            row = {"component": f"fused_kernel/{s['name']}",
+                   "gemm_m": s["m"], "gemm_k": s["k"], "gemm_c": s["c"],
+                   "flops": cost["flops"],
+                   "flops_parts": cost["flops_parts"],
+                   "bytes_accessed": cost["bytes"],
+                   "peak_temp_mib": cost["peak_temp_mib"]}
+            if parts_flops:
+                totals["fused"] += cost["flops"]
+                totals["parts"] += int(parts_flops)
+                row["xla_unfused_parts_flops"] = int(parts_flops)
+                row["conserved"] = bool(
+                    abs(cost["flops"] - parts_flops) <= 0.02 * parts_flops)
+                conserved_all &= row["conserved"]
+            print(json.dumps(row), flush=True)
+        if totals["parts"]:
+            print(json.dumps({
+                "label": "fused-conservation",
+                "fused_flops_total": totals["fused"],
+                "xla_unfused_parts_total": totals["parts"],
+                "ratio": round(totals["fused"] / totals["parts"], 4),
+                "conserved": conserved_all}), flush=True)
 
     # VERDICT Weak #6: XLA's cost model counts a lax.scan (while-loop) body
     # ONCE regardless of trip count, so any in-step scan — the n_critic
