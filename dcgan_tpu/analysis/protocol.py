@@ -87,8 +87,9 @@ _TAINT_CALLS: Dict[str, Tuple[str, ...]] = {
 #: consensus calls whose RESULT is mesh-uniform: assignment from one of
 #: these sanitizes the target name (the blessed gather-then-branch shape)
 _SANITIZERS = frozenset({
-    "anomaly_consensus", "process_allgather", "_allgather_i32",
-    "_allgather_f32", "fleet_health_gather", "broadcast_one_to_all",
+    "anomaly_consensus", "notice_consensus", "process_allgather",
+    "_allgather_i32", "_allgather_f32", "fleet_health_gather",
+    "broadcast_one_to_all",
 })
 
 
@@ -115,9 +116,10 @@ def _is_sanitizer(call: ast.Call) -> bool:
     name, receiver = call_name(call)
     if name in _SANITIZERS:
         return True
-    # stop.poll(): the coordinated-stop consensus — receiver-gated like
-    # the DCG001 table (`opt.poll` / `selector.poll` never match)
-    return name == "poll" and any("stop" in seg
+    # stop.poll() / notice.poll(): the coordinated-stop and
+    # live-elasticity notice consensus polls — receiver-gated like the
+    # DCG001 table (`opt.poll` / `selector.poll` never match)
+    return name == "poll" and any("stop" in seg or "notice" in seg
                                   for seg in receiver.split("."))
 
 

@@ -599,6 +599,44 @@ def enumerate_audits() -> Tuple[List[ProgramAudit], List[CoverageRow]]:
                         else "per-step inside a fade window "
                              "(`--progressive_fade_steps`)"))
 
+        # Live-elasticity variants (ISSUE 18): the target-submesh step
+        # programs a preemption-notice-driven switch lands on, named
+        # @t<data>x<model> by the same LiveTopologyRuntime the trainer
+        # warms — so the coverage row proves a live shrink dispatches only
+        # planned programs (the AOT-warm-both-topologies contract behind
+        # compile_requests_delta == 0 across a switch). The launch
+        # topology's rows keep their plain names and are NOT re-audited
+        # (same programs as the base rows above); only the @t1x1 step row
+        # is traced — sampler/probe rows differ from the base ones only by
+        # mesh extent, which the step row already fingerprints.
+        from dcgan_tpu.elastic.live import LiveTopologyRuntime
+
+        cfg_le = dataclasses.replace(cfg, elastic_target_devices=1,
+                                     sample_every_steps=0)
+        rt_le = LiveTopologyRuntime(
+            cfg_le, mesh, make_pt=lambda c, m: make_parallel_train(c, m),
+            launch_pt=pt)
+        plan_le = rt_le.build_warmup_plan(warmup.state_example(rt_le.pt))
+        sub_tag = rt_le.tag(1)
+        coverage.append(CoverageRow(
+            variant=f"{backend}+live_elastic", path=path,
+            programs=frozenset(rt_le.surface(1)[2].programs),
+            plan=tuple(n for n, _, _ in plan_le),
+            must_cover=frozenset(
+                {"train_step", f"init@{sub_tag}",
+                 f"train_step@{sub_tag}",
+                 f"multi_step@k{cfg_le.steps_per_call}@{sub_tag}",
+                 f"state_copy@{sub_tag}"})))
+        for n, f, a in plan_le:
+            if _base(n) != "train_step" or not n.endswith(f"@{sub_tag}"):
+                continue
+            audits.append(audit_callable(
+                f"{backend}::{n}", f, a, path=path,
+                expect_donation=_base(n) in DONATED_PROGRAMS,
+                cadence=f"every step after a notice-driven live shrink "
+                        f"onto `--elastic_target_devices 1` (grow-back "
+                        f"returns to the plain rows)"))
+
         if backend == "gspmd":
             # the serving plane's rungs: the checkpoint-source sampler at
             # every bucket of the default doubling ladder (granule = the

@@ -79,7 +79,7 @@ SIM_TRANSPORTS = ("_allgather_i32", "_allgather_f32", "fleet_health_gather",
 #: DCGAN_PROTOCOL_LOG in live multi-host runs — the replay-comparison
 #: subset of a simulated schedule (tools/chaos_drill.py mh-sigterm-stop).
 COORD_LOG_OPS = ("stop_consensus", "anomaly_consensus", "fleet_health",
-                 "warmup_barrier")
+                 "warmup_barrier", "notice_consensus")
 
 #: how long the engine waits on a rendezvous before declaring itself
 #: wedged — an ENGINE bug guard, never part of the audited semantics
@@ -128,6 +128,13 @@ class Knobs:
                                        # rollback snapshot; all step-keyed
                                        # and host-local, so the audited
                                        # schedules must stay symmetric
+    live_elastic: bool = False         # arm the live-elasticity notice
+                                       # plane (ISSUE 18): one
+                                       # notice_consensus per boundary;
+                                       # an agreed verdict drives the
+                                       # drain->reshard->snapshot switch
+                                       # sequence (notices land through
+                                       # FaultPlan preempt/grow fields)
 
     def to_json(self) -> Dict[str, object]:
         d = dataclasses.asdict(self)
@@ -570,6 +577,7 @@ def _virtual_trainer(mesh: VirtualMesh, pid: int, knobs: Knobs,
     primed = False
     pending: Optional[dict] = None
     phase_idx = 0   # progressive phase (0 = first/only; the switch bumps)
+    topo_idx = 0    # live-elastic topology (0 = launch mesh, 1 = submesh)
 
     def _gate(rec: dict, *, force: bool = False) -> None:
         """_nan_gate's protocol skeleton: cadence/force keying, the
@@ -620,6 +628,52 @@ def _virtual_trainer(mesh: VirtualMesh, pid: int, knobs: Knobs,
             if knobs.pipeline_gd and primed:
                 mesh.local("pipeline-drain:coordinated-stop")
             break
+        # live-elasticity notice poll (ISSUE 18, trainer's boundary poll):
+        # the local one-shot notice sources fold into the REAL
+        # notice_consensus — the verdict is mesh-uniform, so the switch
+        # sequence below is taken (or skipped) identically everywhere.
+        # Mirror order matches the trainer: pending flush (its gate may
+        # trip and roll back BEHIND the boundary; the consumed notice is
+        # NOT re-raised) -> services drain -> pipeline drain -> live
+        # reshard (a mesh program over the target surface, recorded as
+        # one swap collective) -> loader rebuild -> fresh rollback
+        # snapshot of the re-scattered tree.
+        if knobs.live_elastic:
+            from dcgan_tpu.testing import chaos as _chaos
+
+            local_v = _chaos.NOTICE_NONE
+            if plan and plan.preempt_notice_at_step \
+                    and step_num >= plan.preempt_notice_at_step \
+                    and plan.fire_once("preempt_notice_at_step"):
+                local_v = _chaos.NOTICE_SHRINK
+            elif plan and plan.grow_notice_at_step \
+                    and step_num >= plan.grow_notice_at_step \
+                    and plan.fire_once("grow_notice_at_step"):
+                local_v = _chaos.NOTICE_GROW
+            with mesh.phase(f"notice_consensus@{step_num}"):
+                verdict, _raisers = coordination.notice_consensus(local_v)
+            target = {_chaos.NOTICE_SHRINK: 1,
+                      _chaos.NOTICE_GROW: 0}.get(verdict)
+            if target is not None and target != topo_idx:
+                if pending is not None:
+                    prev, pending = pending, None
+                    try:
+                        _gate(prev)
+                    except FloatingPointError as e:
+                        if rollback is None:
+                            raise
+                        _do_rollback(e)
+                        continue
+                mesh.local("services-drain:elastic-switch")
+                if knobs.pipeline_gd and primed:
+                    mesh.local("pipeline-drain:elastic-switch")
+                    primed = False
+                with mesh.phase(f"live-switch@{step_num}"):
+                    mesh.collective("prog", f"live_reshard@{step_num}")
+                mesh.local("data-rebuild:elastic-switch")
+                topo_idx = target
+                if rollback is not None:
+                    rollback.snapshot(step_num, state)
         # progressive phase switch (ISSUE 15, trainer's phase-boundary
         # step): a pure function of step_num and the schedule — every
         # process takes it at the same boundary with ZERO extra
@@ -663,6 +717,11 @@ def _virtual_trainer(mesh: VirtualMesh, pid: int, knobs: Knobs,
         zs = f"@zero{knobs.zero_stage}" if knobs.zero_stage > 1 else ""
         if knobs.progressive_switch_at:
             zs += f"@phase{phase_idx}"
+        if knobs.live_elastic:
+            # the dispatch stream names the ACTIVE topology's programs —
+            # a post-switch asymmetry (one host still dispatching the old
+            # surface) breaks the lockstep audit right here
+            zs += f"@topo{topo_idx}"
         if knobs.pipeline_gd:
             if not primed:
                 mesh.collective("prog", f"gen_fakes{zs}@{step_num}")
@@ -826,6 +885,18 @@ def configs() -> List[Knobs]:
         Knobs("progressive-switch", nan_policy="rollback",
               nan_check_steps=1, progressive_switch_at=3,
               pipeline_gd=True, aot_warmup=True),
+        # live-elasticity notice at a boundary (ISSUE 18): the
+        # notice_consensus poll runs EVERY boundary; an agreed verdict
+        # drives flush->drain->reshard->snapshot, and the audited
+        # schedules must stay symmetric whichever single host the notice
+        # lands on — including a shrink-then-grow round trip and a NaN
+        # tripping right AFTER the switch (rollback restores the
+        # post-switch re-scattered tree). The trainer restricts the
+        # switch itself to single-controller runs; this config proves the
+        # CONSENSUS half holds lockstep on a multi-host mesh.
+        Knobs("live-elastic-switch", nan_policy="rollback",
+              nan_check_steps=1, live_elastic=True,
+              pipeline_gd=True, aot_warmup=True),
     ]
 
 
@@ -889,6 +960,33 @@ def faults_for(k: Knobs) -> List[Fault]:
         out.append(F(f"nan@p0@{s}", {0: {"nan_at_step": s}}))
         if k.n_proc > 1:
             out.append(F(f"nan@p1@{s}", {1: {"nan_at_step": s}}))
+    if k.live_elastic:
+        mid = min(3, k.total_steps - 1)
+        # a notice on either single host (and on both at once — the
+        # consensus max resolves it) must produce identical switch
+        # schedules; the grow-back row round-trips submesh -> launch mesh
+        out += [
+            F(f"notice@p0@{mid}", {0: {"preempt_notice_at_step": mid}}),
+            F(f"notice@p1@{mid}", {1: {"preempt_notice_at_step": mid}}),
+            F(f"notice@both@{mid}", {0: {"preempt_notice_at_step": mid},
+                                     1: {"preempt_notice_at_step": mid}}),
+            F(f"notice@p0@{mid}+grow@{mid + 2}",
+              {0: {"preempt_notice_at_step": mid,
+                   "grow_notice_at_step": mid + 2}}),
+            # shrink raised on one host, grow on the other at the SAME
+            # boundary: the consensus max must resolve to shrink (losing
+            # capacity is honored) on every host
+            F(f"notice@p0@{mid}+grow@p1@{mid}",
+              {0: {"preempt_notice_at_step": mid},
+               1: {"grow_notice_at_step": mid}}),
+        ]
+        if gate:
+            # the drill scenario's shape: the gate trips at the FIRST
+            # step after the live switch — rollback must restore the
+            # post-switch snapshot (the re-scattered tree), on every host
+            out.append(F(f"notice@p0@{mid}+nan@p1@{mid + 1}",
+                         {0: {"preempt_notice_at_step": mid},
+                          1: {"nan_at_step": mid + 1}}))
     if k.collective_timeout_secs > 0 and k.n_proc > 1:
         out += [
             F("hang@p1@3", {1: {"hang_at_step": 3}}),

@@ -634,6 +634,30 @@ class TrainConfig:
                                    # ramping D's real distribution from
                                    # previous-resolution content to full
                                    # detail. 0 = hard switches
+    elastic_target_devices: int = 0  # live in-run elasticity (ISSUE 18):
+                                   # >0 arms a second pre-built topology
+                                   # surface over the first N devices (N
+                                   # divisible by mesh.model) and the
+                                   # preemption-notice boundary poll. A
+                                   # shrink notice (SIGUSR1, the notice
+                                   # file, or a chaos plan) moves the LIVE
+                                   # state onto the smaller mesh without a
+                                   # restart — drain, reshard, resume from
+                                   # pre-warmed executables (compile-
+                                   # request delta 0 under --aot_warmup);
+                                   # a grow notice moves back. Global
+                                   # batch and model are unchanged (the
+                                   # math is layout-invariant). Single-
+                                   # controller runs only. 0 = off
+                                   # (parity: no poll, no extra surface)
+    elastic_notice_file: str = ""  # with elastic_target_devices: a file
+                                   # path polled (retry_io-guarded) at
+                                   # each step boundary — `touch <file>`
+                                   # is a shrink notice, content "grow"
+                                   # the grow-back; consumed notices are
+                                   # renamed *.consumed and acked to
+                                   # *.ack with the switch record. "" =
+                                   # signal/chaos sources only
     pipeline_gd: bool = False      # software-pipelined G/D dispatch
                                    # (ISSUE 7, ParaGAN's separable-stage
                                    # framing): the fused train step is
@@ -919,6 +943,31 @@ class TrainConfig:
                            steps_per_call=self.steps_per_call,
                            grad_accum=self.grad_accum,
                            fade_steps=self.progressive_fade_steps)
+        if self.elastic_target_devices < 0:
+            raise ValueError(
+                f"elastic_target_devices must be >= 0, got "
+                f"{self.elastic_target_devices}")
+        if self.elastic_target_devices:
+            if self.progressive:
+                raise ValueError(
+                    "--elastic_target_devices does not compose with "
+                    "--progressive: both own the phase-boundary switch "
+                    "sequence and the warmed-surface table, and a notice "
+                    "landing mid-schedule would have to re-warm every "
+                    "remaining phase on the new mesh under the "
+                    "zero-recompile contract; run fixed-resolution, or "
+                    "take the restart-based elastic path between phases")
+            if self.mesh.model > 0 \
+                    and self.elastic_target_devices % self.mesh.model:
+                raise ValueError(
+                    f"elastic_target_devices="
+                    f"{self.elastic_target_devices} must be divisible by "
+                    f"the model axis (mesh.model={self.mesh.model}) — the "
+                    "live switch resizes the data axis only")
+        if self.elastic_notice_file and not self.elastic_target_devices:
+            raise ValueError(
+                "--elastic_notice_file without --elastic_target_devices "
+                "is a silent no-op — arm a target topology to switch to")
         if self.prefetch_device_batches < 0:
             raise ValueError(
                 f"prefetch_device_batches must be >= 0, got "

@@ -27,6 +27,11 @@ Injection points in production code:
   that never joins the next collective — the other processes block in a
   real allgather/allreduce and the hung-collective watchdog must trip on
   every process.
+- `poll_notice(step)`        elastic/live.py's NoticePlane: returns a
+  preemption-notice verdict (NOTICE_SHRINK / NOTICE_GROW) once at
+  `preempt_notice_at_step` / `grow_notice_at_step` — the deterministic
+  stand-in for a scheduler's advance preemption notice, driving the
+  live mesh shrink/grow-back switch without a real signal or file.
 
 Multi-process plans (ISSUE 4): when the DCGAN_CHAOS JSON object's keys are
 all digit strings, it is a PER-PROCESS map `{"<pid>": {fields...}}` selected
@@ -77,6 +82,12 @@ class FaultPlan:
                                    # joins the next collective
     hang_secs: float = 3600.0      # how long hang_at_step sleeps (far past
                                    # any sane collective_timeout_secs)
+    preempt_notice_at_step: int = 0  # >0: raise a preemption notice (live
+                                     # mesh SHRINK) at that step boundary
+                                     # (once) — consumed by poll_notice
+    grow_notice_at_step: int = 0     # >0: raise a capacity-restored notice
+                                     # (live mesh GROW-back) at that step
+                                     # boundary (once)
     _fired: Set[str] = dataclasses.field(default_factory=set)
 
     def fire_once(self, name: str) -> bool:
@@ -192,6 +203,33 @@ def maybe_hang(step: int) -> None:
         print(f"[dcgan_tpu] chaos: hanging process for {plan.hang_secs:.0f}s "
               f"at step {step}", flush=True)
         time.sleep(plan.hang_secs)
+
+
+#: poll_notice verdicts — match elastic/live.py's wire encoding (0 = no
+#: notice) so the chaos hook slots straight into the consensus vote.
+NOTICE_NONE = 0
+NOTICE_GROW = 1
+NOTICE_SHRINK = 2   # outranks GROW under the consensus max — when hosts
+                    # disagree, losing capacity is the direction to honor
+
+
+def poll_notice(step: int) -> int:
+    """Local preemption-notice verdict for this step boundary: NOTICE_SHRINK
+    once at `preempt_notice_at_step`, NOTICE_GROW once at
+    `grow_notice_at_step`, else NOTICE_NONE. One-shot like every hook — the
+    notice is an edge, not a level; the consensus collective (elastic/live)
+    spreads it to every process, so re-firing on the replayed boundary would
+    double-switch."""
+    plan = active_plan()
+    if plan and plan.preempt_notice_at_step \
+            and step >= plan.preempt_notice_at_step \
+            and plan.fire_once("preempt_notice_at_step"):
+        return NOTICE_SHRINK
+    if plan and plan.grow_notice_at_step \
+            and step >= plan.grow_notice_at_step \
+            and plan.fire_once("grow_notice_at_step"):
+        return NOTICE_GROW
+    return NOTICE_NONE
 
 
 # -- disk-fault helpers (drill/tests only; never called by production) -------

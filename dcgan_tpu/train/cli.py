@@ -320,6 +320,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "first N steps of each later phase (real images "
                         "blend toward their previous-resolution content; "
                         "alpha is a traced scalar, one compile per phase)")
+    p.add_argument("--elastic_target_devices", type=int, default=0,
+                   help=">0 arms live in-run elasticity: a second topology "
+                        "surface over the first N devices is AOT-warmed at "
+                        "startup, and a preemption notice (SIGUSR1, "
+                        "--elastic_notice_file, or a chaos plan) shrinks "
+                        "the live mesh to it — drain, reshard, resume, no "
+                        "restart; a grow notice switches back. "
+                        "Single-controller runs only; 0 = off")
+    p.add_argument("--elastic_notice_file", type=str, default="",
+                   help="with --elastic_target_devices: notice file polled "
+                        "each step boundary (touch = shrink, content "
+                        "'grow' = grow-back); consumed notices rename to "
+                        "*.consumed and the switch record lands in *.ack")
     p.add_argument("--steps_per_call", type=int, default=1,
                    help=">1 dispatches K steps as one compiled scan program "
                         "(sheds per-dispatch RPC overhead; observability "
@@ -370,6 +383,8 @@ _FLAG_FIELDS = {
     "pipeline_gd": ("", "pipeline_gd"),
     "progressive": ("", "progressive"),
     "progressive_fade_steps": ("", "progressive_fade_steps"),
+    "elastic_target_devices": ("", "elastic_target_devices"),
+    "elastic_notice_file": ("", "elastic_notice_file"),
     "dataset": ("", "dataset"), "data_dir": ("", "data_dir"),
     "sample_image_dir": ("", "sample_image_dir"),
     "record_dtype": ("", "record_dtype"),

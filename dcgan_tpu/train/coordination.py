@@ -75,6 +75,10 @@ TRANSPORT_CENSUS = {
     "fleet_health": ("_allgather_f32", {"all_gather": 1},
                      "every `fleet_health_steps`-th boundary (default 0 = "
                      "off; single-process: 1-row table, no collective)"),
+    "notice_consensus": ("_allgather_i32", {"all_gather": 1},
+                         "every step boundary when `--elastic_target_"
+                         "devices` arms live elasticity (default 0 = off; "
+                         "single-process: local verdict, no collective)"),
 }
 
 
@@ -212,6 +216,31 @@ def warmup_barrier(tag: str = "aot-warmup") -> None:
 
     _sched_log("warmup_barrier")
     multihost_utils.sync_global_devices(tag)
+
+
+def notice_consensus(local: int) -> Tuple[int, List[int]]:
+    """Agree on a preemption/capacity notice: (agreed verdict, raisers).
+
+    The live-elasticity analogue of `CoordinatedStop.poll` (ISSUE 18): a
+    scheduler's advance notice lands on ONE host (touch-file, SIGUSR1, or a
+    chaos plan), but a mesh shrink is a collective act — every process must
+    take the identical switch branch at the identical step boundary, or the
+    survivors dispatch collectives the leaver never joins. `local` is this
+    process's verdict (0 none / 1 grow / 2 shrink — the
+    testing/chaos NOTICE_* encoding); the return is identical on every
+    process: the max verdict (shrink outranks grow outranks none, so a
+    simultaneous shrink+grow resolves to the safe direction) plus the
+    processes that raised it. Single-process: the local verdict, no
+    collective — same shape, so the switch path is testable on CPU.
+    """
+    if jax.process_count() == 1:
+        return int(local), [0] if local else []
+    _sched_log("notice_consensus")
+    gathered = _allgather_i32(int(local))
+    if not gathered.any():
+        return 0, []
+    return (int(gathered.max()),
+            [int(i) for i in np.nonzero(gathered)[0]])
 
 
 class CoordinatedStop:

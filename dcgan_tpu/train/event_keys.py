@@ -74,6 +74,16 @@ EVENT_KEYS: Dict[str, str] = {
     "perf/restore/reshard_ms": "cross-topology restore",
     "perf/restore/reshard_leaves": "cross-topology restore",
 
+    # -- live in-run elasticity (ISSUE 18): one scalar row per
+    #    notice-driven topology switch. Gated by the switch EVENT, not
+    #    the knob — an armed-but-unnotified run emits none of these, so
+    #    its stream stays byte-identical to an unarmed run (the
+    #    default-off parity A/B in tests/test_live_elastic.py) ------------
+    "elastic/live_notice_step": "live elasticity switch",
+    "elastic/live_switch_ms": "live elasticity switch",
+    "elastic/live_target_mesh": "live elasticity switch",
+    "elastic/live_resumed_step": "live elasticity switch",
+
     # -- fleet health plane (ISSUE 6, coordination.fleet_metrics) --------
     "fleet/step_ms_max": "fleet_health_steps",
     "fleet/step_ms_min": "fleet_health_steps",

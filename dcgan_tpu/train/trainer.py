@@ -94,9 +94,20 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
                    data_dir: Optional[str] = None,
                    seed_offset: int = 0,
                    n_threads: Optional[int] = None,
-                   min_after_dequeue: Optional[int] = None) -> Iterator:
+                   min_after_dequeue: Optional[int] = None,
+                   skip_batches: int = 0) -> Iterator:
     """Yields sharded image batches — (images, labels) pairs for conditional
-    models (cfg.model.num_classes > 0)."""
+    models (cfg.model.num_classes > 0).
+
+    `skip_batches` fast-forwards a REBUILT iterator past batches an earlier
+    incarnation already consumed (the live-elasticity switch, ISSUE 18:
+    the yielded arrays are committed to the mesh, so a mesh change forces
+    a rebuild — but the stream position must carry, or the synthetic
+    generator restarts at batch 0 and the post-switch run diverges from
+    its same-topology control). Synthetic streams skip at the host
+    generator (cheap — no slicing, no upload); real-data loaders discard
+    yielded batches (best-effort: a threaded shuffle stream has no exact
+    position to restore anyway)."""
     sharding = batch_sharding(mesh, 4, spatial=cfg.mesh.spatial)
     conditional = cfg.model.num_classes > 0
     label_sharding = batch_sharding(mesh, 1) if conditional else None
@@ -153,6 +164,8 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
                     return batch[0][(slice(None),) + hwc], batch[1]
                 return batch[(slice(None),) + hwc]
 
+        for _ in range(skip_batches):
+            next(src)
         if cfg.synthetic_device_cache > 0:
             def it():
                 # pre-staged device pool, cycled forever: the loop consumes
@@ -228,11 +241,14 @@ def _data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
         num_classes=cfg.model.num_classes if conditional else 0,
         prefetch_device_batches=cfg.prefetch_device_batches,
         max_corrupt_records=cfg.max_corrupt_records)
-    return make_dataset(dcfg, sharding, label_sharding)
+    ds = make_dataset(dcfg, sharding, label_sharding)
+    for _ in range(skip_batches):
+        next(ds)
+    return ds
 
 
-def _sample_data_iterator(cfg: TrainConfig, mesh, *,
-                          synthetic: bool) -> Optional[Iterator]:
+def _sample_data_iterator(cfg: TrainConfig, mesh, *, synthetic: bool,
+                          skip_batches: int = 0) -> Optional[Iterator]:
     """The reference's SECOND input pipeline over sample_image_dir
     (image_train.py:84), feeding the every-100-steps sample-loss probe
     (:179-192). Optional here: present in synthetic mode (held-out stream,
@@ -240,7 +256,8 @@ def _sample_data_iterator(cfg: TrainConfig, mesh, *,
     otherwise — the probe is skipped, not an error (the reference crashed
     without the directory)."""
     if synthetic:
-        return _data_iterator(cfg, mesh, synthetic=True, seed_offset=100)
+        return _data_iterator(cfg, mesh, synthetic=True, seed_offset=100,
+                              skip_batches=skip_batches)
     exists = os.path.isdir(cfg.sample_image_dir)
     if jax.process_count() > 1:
         # The probe runs mesh-wide collectives; every process must make the
@@ -264,7 +281,8 @@ def _sample_data_iterator(cfg: TrainConfig, mesh, *,
         return _data_iterator(
             cfg, mesh, synthetic=False, data_dir=cfg.sample_image_dir,
             seed_offset=100, n_threads=2,
-            min_after_dequeue=4 * cfg.batch_size)
+            min_after_dequeue=4 * cfg.batch_size,
+            skip_batches=skip_batches)
     return None
 
 
@@ -436,6 +454,26 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
     # default-flags dispatch stream and event values are untouched (the
     # parity contract).
     pipeline = GDPipeline() if cfg.pipeline_gd else None
+    # Live in-run elasticity (ISSUE 18, DESIGN.md §6l): a preemption/
+    # capacity notice switches the run onto `--elastic_target_devices`
+    # (or back) WITHOUT a restart. Two halves, both strictly opt-in (every
+    # live_* branch below is gated on live_rt, so the default dispatch
+    # stream and event bytes are untouched — the parity contract):
+    # NoticePlane folds the local notice sources (touch file, SIGUSR1,
+    # chaos fault) into a boundary-poll consensus with the stop plane's
+    # shape, and LiveTopologyRuntime holds one warmed ParallelTrain per
+    # topology so the switch dispatches only cached executables. The
+    # runtime adopts the launch surface built above; the target surface
+    # builds lazily (warmup builds it eagerly so both get primed).
+    live_rt = None
+    notice = None
+    if cfg.elastic_target_devices:
+        from dcgan_tpu.elastic import live as live_elastic
+
+        live_rt = live_elastic.LiveTopologyRuntime(
+            cfg, mesh, make_pt=make_parallel_train, launch_pt=pt)
+        notice = live_elastic.NoticePlane(cfg.elastic_notice_file)
+        notice.install()
     # the quarantine tally is process-global (it spans both loader
     # implementations and the train+sample pipelines); this run reports its
     # own delta — captured BEFORE any loader thread starts — so counts from
@@ -713,6 +751,27 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                           + ", ".join(f"{k} {v:.0f}ms"
                                       for k, v in prime_ms.items()),
                           flush=True)
+            elif live_rt is not None:
+                # live-elastic warmup (ISSUE 18): BOTH topologies' programs
+                # enter the plan (@t<data>x<model> rows for the target
+                # submesh), then each topology is primed with one
+                # throwaway dispatch per program — the same PR 14
+                # mechanism, transposed from resolution phases to mesh
+                # change, so a notice-driven switch dispatches only
+                # already-executed programs (compile-request delta 0)
+                plan = live_rt.build_warmup_plan(
+                    state,
+                    sample_z=sample_z if cfg.sample_every_steps else None,
+                    sample_labels=sample_labels)
+                warm_ms = warmup.aot_compile(plan)
+                prime_ms = live_rt.prime(
+                    sample_z=sample_z if cfg.sample_every_steps else None,
+                    sample_labels=sample_labels)
+                if chief:
+                    print("[dcgan_tpu] live-elastic warmup primed "
+                          + ", ".join(f"{k} {v:.0f}ms"
+                                      for k, v in prime_ms.items()),
+                          flush=True)
             else:
                 plan, pt_backoff = warmup.build_warmup_plan(
                     cfg, pt, state,
@@ -736,7 +795,8 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
     # caches directly, so it is warm proof even without a fleet-wide
     # persistent cache; the plain AOT path still needs cache hits to stick
     warm_proof = cfg.aot_warmup and (
-        cache_fleet_wide or (prog is not None and prog.primed))
+        cache_fleet_wide or (prog is not None and prog.primed)
+        or (live_rt is not None and live_rt.primed))
 
     start_step = int(jax.device_get(state["step"]))
     t_start = time.time()
@@ -776,6 +836,10 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         # flight-recorder records and the fleet health vector both name
         # the active phase through the one counter surface (ISSUE 15)
         registry.provide("progressive_phase", lambda: prog.index)
+    if live_rt is not None:
+        # the ACTIVE topology's device count (ISSUE 18): flight-recorder
+        # dumps after a switch name the mesh the run was actually on
+        registry.provide("live_topology", lambda: live_rt.device_count)
     if cache_mon is not None:
         registry.provide_group(
             ("compile_cache_requests", "compile_cache_hits",
@@ -1333,6 +1397,128 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 # mid-write relative to the state that was saved
                 svc.drain()
                 break
+            # Live-elasticity notice poll (ISSUE 18, DESIGN.md §6l): the
+            # same boundary-poll consensus shape as the stop poll above —
+            # the local sources (touch file, SIGUSR1, chaos fault) fold
+            # into one verdict through notice_consensus, so every process
+            # takes the identical switch branch at the identical boundary.
+            # Single-process (the live-switch scope) reads the local
+            # verdict with no collective; the guarded multi-host arm is
+            # the consensus half the protocol tier proves symmetric.
+            notice_sig = 0
+            if live_rt is not None:
+                if n_proc == 1:
+                    notice_sig, notice_origins = notice.poll(step_num)
+                else:
+                    with _guard("notice-consensus", step_num):
+                        notice_sig, notice_origins = notice.poll(step_num)
+            if notice_sig:
+                live_target = live_rt.target_index(notice_sig)
+                verdict_name = live_elastic.VERDICT_NAMES.get(
+                    notice_sig, "?")
+                if live_target is None:
+                    # already on the asked-for topology (a grow notice on
+                    # the full mesh, a repeated shrink): consume the
+                    # notice — an unacked file would re-raise every
+                    # boundary — and carry on without a switch
+                    notice.ack(step=step_num, verdict=notice_sig,
+                               target=live_rt.tag(), switch_ms=0.0)
+                    if chief:
+                        print(f"[dcgan_tpu] {verdict_name} notice at step "
+                              f"{step_num}: already on {live_rt.tag()} — "
+                              f"consumed, no switch", flush=True)
+                else:
+                    # Live topology switch: the PR 14 phase-boundary
+                    # sequence pointed at a mesh change. Flush the
+                    # lag-by-one record (pre-switch metrics; a gate trip
+                    # rolls back BEHIND the boundary — the consumed
+                    # notice is NOT re-raised, the scheduler re-notifies
+                    # if it still wants the capacity) -> services drain
+                    # (queued telemetry referencing old-mesh arrays lands
+                    # before their buffers die) -> G/D pipeline drain
+                    # (the fake stack is mesh-committed) -> state
+                    # re-scatter onto the target surface -> loader
+                    # rebuild (batches are mesh-committed too),
+                    # fast-forwarded past the consumed prefix -> fresh
+                    # rollback snapshot -> StepTimer/compiled_ks re-armed.
+                    # With --aot_warmup both topologies were primed at
+                    # startup, so the switch issues zero compile requests
+                    # (the printed delta, drill-pinned).
+                    if pending is not None:
+                        prev, pending = pending, None
+                        if not _consume_or_rollback(prev):
+                            continue
+                    t_sw = time.perf_counter()
+                    svc.drain()
+                    if pipeline is not None:
+                        with _guard("pipeline-drain", step_num):
+                            pipeline.drain("elastic-switch")
+                    old_tag = live_rt.tag()
+                    state = live_rt.switch(state, notice_sig)
+                    pt = live_rt.pt
+                    mesh = live_rt.mesh
+                    for closing in (data, sample_data):
+                        if closing is not None and hasattr(closing,
+                                                           "close"):
+                            try:
+                                closing.close()
+                            except Exception:
+                                pass
+                    data = _data_iterator(
+                        cfg, mesh, synthetic=synthetic_data,
+                        skip_batches=step_num - start_step)
+                    if sample_data is not None:
+                        se = cfg.sample_every_steps
+                        probes = (step_num // se - start_step // se) \
+                            if se else 0
+                        if cfg.fid_every_steps and fid_real_side is not None:
+                            # the one-shot real side consumed its batches
+                            # from this stream too
+                            probes += -(-cfg.fid_num_samples
+                                        // cfg.batch_size)
+                        sample_data = _sample_data_iterator(
+                            cfg, mesh, synthetic=synthetic_data,
+                            skip_batches=probes)
+                        if n_proc == 1 and cfg.fid_every_steps:
+                            # single-process probe aliases the held-out
+                            # stream — re-point it at the rebuilt one
+                            fid_probe_data = sample_data
+                    timer = StepTimer(window=cfg.timing_window,
+                                      images_per_step=pcfg.batch_size)
+                    compiled_ks.clear()
+                    if live_rt.primed:
+                        compiled_ks.add(1)
+                        if cfg.steps_per_call > 1:
+                            compiled_ks.add(cfg.steps_per_call)
+                    if rollback is not None:
+                        # a NaN right after the switch must restore the
+                        # NEW topology's tree, never re-scatter the old
+                        rollback.snapshot(step_num, state)
+                    switch_ms = (time.perf_counter() - t_sw) * 1e3
+                    note = ""
+                    if cache_mon is not None and warm_base is not None:
+                        d = warmup.CompileCacheMonitor.delta(
+                            cache_mon.counters(), warm_base)
+                        note = f" compile_requests_delta=" \
+                               f"{int(d['requests'])}"
+                    if chief:
+                        print(f"[dcgan_tpu] live elastic switch at step "
+                              f"{step_num}: {old_tag} -> {live_rt.tag()} "
+                              f"({verdict_name} notice, "
+                              f"{live_rt.last_switch_ms:.1f}ms state "
+                              f"move) switch_ms={switch_ms:.1f}{note}",
+                              flush=True)
+                        srow = {
+                            "elastic/live_notice_step": float(step_num),
+                            "elastic/live_switch_ms": switch_ms,
+                            "elastic/live_target_mesh":
+                                float(live_rt.device_count),
+                            "elastic/live_resumed_step": float(step_num)}
+                        svc.submit(lambda s=step_num, r=srow:
+                                   writer.write_scalars(s, r),
+                                   tag="elastic")
+                    notice.ack(step=step_num, verdict=notice_sig,
+                               target=live_rt.tag(), switch_ms=switch_ms)
             # Phase boundary (ISSUE 15, DESIGN.md §6j): the switch decision
             # is a pure function of step_num and the schedule, so every
             # process takes it at the same boundary with zero extra
@@ -1805,6 +1991,11 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
         # below still wants its deadline) so a fast abort path cannot race
         # a stale deadline into a spurious process exit during cleanup.
         watchdog.disarm()
+        if notice is not None:
+            # hand SIGUSR1 back on every exit path — a process that calls
+            # train() again (tests, drills) must not deliver a late
+            # notice into a dead plane
+            notice.restore()
         if pipeline is not None:
             # release the buffer on every exit path (normal completion,
             # abort, loader error) — nothing past the loop consumes it

@@ -37,6 +37,14 @@ is that discipline for tpu-dcgan's time-to-first-step:
   cost is bounded IO, not compile — which is what lets the trainer's
   watchdog arm from warmup PROOF (mesh_warm + the `compiled_ks` exemption
   set) instead of waiting for first live steps.
+
+Plan-row naming: the launch surface's rows carry plain program names;
+variant surfaces suffix theirs so one plan can warm several compiled
+surfaces without name collisions — `@lr_backoff` (the rollback rebuild,
+this module), `@r<res>` (progressive phases, progressive/phases.py),
+`@t<data>x<model>` (live-elasticity topologies, elastic/live.py). The
+semantic tier's coverage rows (analysis/semantic.py, DCG009) pin the
+suffixed names, so a renamed row is a lock diff, not a silent miss.
 """
 
 from __future__ import annotations

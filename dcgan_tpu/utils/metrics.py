@@ -47,6 +47,13 @@ class CounterSnapshot:
                                    # both read it, so a crash dump or a
                                    # straggler row names the phase it
                                    # happened in
+    live_topology: int = 0         # live-elasticity topology in effect
+                                   # (ISSUE 18): the ACTIVE mesh's device
+                                   # count when a notice-driven switch has
+                                   # happened, 0 before any switch (and in
+                                   # every unarmed run) — a crash dump or
+                                   # fleet row from a shrunk run names the
+                                   # mesh it ran on
     master_f32_leaves: int = 0     # f32 Adam master-moment leaves under a
                                    # reduced-precision policy (ISSUE 17,
                                    # elastic/rules.py::

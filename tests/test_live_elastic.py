@@ -1,0 +1,431 @@
+"""Live in-run elasticity (ISSUE 18): the preemption-notice plane, the
+two-topology runtime, and the trainer's no-restart mesh switch.
+
+Layers covered, cheapest first: config validation (the combinations the
+switch cannot honor are rejected at construction), NoticePlane local
+sources (file/word parsing, SIGUSR1 flag, retry_io-wrapped reads, the ack
+contract), the LiveTopologyRuntime surface/tag/verdict mapping, the
+BIT-LOSSLESS state move between meshes (both sides observable in-process —
+the cross-arm drills in tools/chaos_drill.py can only bound the
+post-switch *trajectory*, which legitimately differs across device counts
+because the data-axis reduction order changes), the warmup-plan naming
+contract the semantic tier pins, and full in-process trainer runs: a
+chaos-notice switch with compile_requests_delta=0, the --pipeline_gd
+seam, the ZeRO-2/3 state-move seam, and the armed-but-unnotified parity
+A/B (arming elasticity without a notice must not perturb the run).
+"""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+
+from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
+from dcgan_tpu.elastic import live
+from dcgan_tpu.parallel import make_mesh, make_parallel_train
+from dcgan_tpu.testing import chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.set_plan(None)
+    yield
+    chaos.set_plan(None)
+
+
+def _model():
+    return ModelConfig(output_size=16, gf_dim=8, df_dim=8,
+                       compute_dtype="float32")
+
+
+def _cfg(tmp_path=None, **kw):
+    kw.setdefault("model", _model())
+    kw.setdefault("batch_size", 8)
+    kw.setdefault("tensorboard", False)
+    kw.setdefault("sample_every_steps", 0)
+    kw.setdefault("activation_summary_steps", 0)
+    kw.setdefault("save_summaries_secs", 0.0)
+    kw.setdefault("save_model_secs", 1e9)
+    kw.setdefault("save_model_steps", 10_000)
+    kw.setdefault("log_every_steps", 1)
+    kw.setdefault("synthetic_global_stream", True)
+    if tmp_path is not None:
+        kw.setdefault("checkpoint_dir", str(tmp_path / "ckpt"))
+        kw.setdefault("sample_dir", str(tmp_path / "samples"))
+    return TrainConfig(**kw)
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+class TestConfigValidation:
+    def test_negative_target_rejected(self):
+        with pytest.raises(ValueError, match="must be >= 0"):
+            _cfg(elastic_target_devices=-1)
+
+    def test_progressive_combo_rejected(self):
+        with pytest.raises(ValueError, match="does not compose with"):
+            _cfg(elastic_target_devices=1, progressive="8:2,16:*")
+
+    def test_model_axis_divisibility_rejected(self):
+        with pytest.raises(ValueError, match="divisible by"):
+            _cfg(elastic_target_devices=3,
+                 mesh=MeshConfig(data=0, model=2))
+
+    def test_notice_file_without_target_rejected(self):
+        with pytest.raises(ValueError, match="silent no-op"):
+            _cfg(elastic_notice_file="/tmp/notice")
+
+    def test_armed_config_valid(self):
+        cfg = _cfg(elastic_target_devices=4,
+                   elastic_notice_file="/tmp/notice")
+        assert cfg.elastic_target_devices == 4
+
+
+# ---------------------------------------------------------------------------
+# NoticePlane: local sources + consensus + ack
+# ---------------------------------------------------------------------------
+
+class TestNoticePlane:
+    def test_parse_notice_text(self):
+        assert live._parse_notice_text("") == live.NOTICE_SHRINK
+        assert live._parse_notice_text("shrink\n") == live.NOTICE_SHRINK
+        assert live._parse_notice_text("anything else") \
+            == live.NOTICE_SHRINK
+        for word in ("grow", "GROW", "restore", "grow-back"):
+            assert live._parse_notice_text(word + "\n") == live.NOTICE_GROW
+
+    def test_no_sources_is_none(self):
+        plane = live.NoticePlane("")
+        assert plane.poll(1) == (live.NOTICE_NONE, [])
+
+    def test_touch_file_is_shrink_consensus(self, tmp_path):
+        f = tmp_path / "notice"
+        plane = live.NoticePlane(str(f))
+        assert plane.poll(1) == (live.NOTICE_NONE, [])
+        f.write_text("")
+        assert plane.poll(2) == (live.NOTICE_SHRINK, [0])
+        f.write_text("grow\n")
+        assert plane.poll(3) == (live.NOTICE_GROW, [0])
+
+    def test_file_read_rides_retry_io(self, tmp_path):
+        # one injected transient EIO at the "notice-poll" tag must be
+        # absorbed by the bounded retry, not misread as "no notice"
+        f = tmp_path / "notice"
+        f.write_text("grow\n")
+        chaos.set_plan(chaos.FaultPlan(io_error_once="notice-poll"))
+        plane = live.NoticePlane(str(f))
+        assert plane.local_verdict(1) == live.NOTICE_GROW
+
+    def test_sigusr1_sets_one_shot_shrink(self):
+        plane = live.NoticePlane("")
+        plane.install()
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert plane.local_verdict(1) == live.NOTICE_SHRINK
+            # one-shot: the flag clears on consumption
+            assert plane.local_verdict(2) == live.NOTICE_NONE
+        finally:
+            plane.restore()
+
+    def test_chaos_plan_is_a_source(self):
+        chaos.set_plan(chaos.FaultPlan(preempt_notice_at_step=3))
+        plane = live.NoticePlane("")
+        assert plane.local_verdict(2) == live.NOTICE_NONE
+        assert plane.local_verdict(3) == live.NOTICE_SHRINK
+        assert plane.local_verdict(4) == live.NOTICE_NONE  # one-shot
+
+    def test_ack_consumes_file_and_writes_record(self, tmp_path):
+        f = tmp_path / "notice"
+        f.write_text("")
+        plane = live.NoticePlane(str(f))
+        plane.ack(step=7, verdict=live.NOTICE_SHRINK, target="t1x1",
+                  switch_ms=12.5)
+        assert not f.exists()
+        assert (tmp_path / "notice.consumed").exists()
+        record = json.loads((tmp_path / "notice.ack").read_text())
+        assert record == {"step": 7, "verdict": "shrink",
+                          "target_mesh": "t1x1", "switch_ms": 12.5}
+        # a consumed notice no longer raises at the next boundary
+        assert plane.poll(8) == (live.NOTICE_NONE, [])
+
+
+# ---------------------------------------------------------------------------
+# submesh_config + LiveTopologyRuntime mapping
+# ---------------------------------------------------------------------------
+
+class TestSubmeshConfig:
+    def test_resizes_data_axis_only(self):
+        cfg = _cfg(elastic_target_devices=1, mesh=MeshConfig(data=2))
+        sub = live.submesh_config(cfg, 1)
+        assert sub.mesh.data == 1 and sub.mesh.model == cfg.mesh.model
+        assert sub.batch_size == cfg.batch_size
+        assert sub.model == cfg.model
+
+    def test_rejects_non_divisible(self):
+        cfg = _cfg(elastic_target_devices=2,
+                   mesh=MeshConfig(data=2, model=2))
+        with pytest.raises(ValueError, match="not divisible"):
+            live.submesh_config(cfg, 3)
+
+
+def _runtime(zero_stage=1, target=1, data=2):
+    cfg = _cfg(elastic_target_devices=target,
+               mesh=MeshConfig(data=data, zero_stage=zero_stage))
+    mesh = make_mesh(cfg.mesh, jax.devices()[:data])
+    return cfg, live.LiveTopologyRuntime(cfg, mesh)
+
+
+class TestRuntimeMapping:
+    def test_rejects_target_equal_to_launch(self):
+        cfg = _cfg(elastic_target_devices=2, mesh=MeshConfig(data=2))
+        mesh = make_mesh(cfg.mesh, jax.devices()[:2])
+        with pytest.raises(ValueError, match="nothing to switch"):
+            live.LiveTopologyRuntime(cfg, mesh)
+
+    def test_rejects_out_of_range_target(self):
+        cfg = _cfg(elastic_target_devices=len(jax.devices()) + 1,
+                   mesh=MeshConfig(data=2))
+        mesh = make_mesh(cfg.mesh, jax.devices()[:2])
+        with pytest.raises(ValueError, match="available devices"):
+            live.LiveTopologyRuntime(cfg, mesh)
+
+    def test_tags_and_device_count(self):
+        _cfg_, rt = _runtime()
+        assert rt.tag(0) == "t2x1" and rt.tag(1) == "t1x1"
+        assert rt.tag() == "t2x1"
+        assert rt.device_count == 2
+
+    def test_verdict_to_target_index(self):
+        _cfg_, rt = _runtime()
+        assert rt.target_index(live.NOTICE_SHRINK) == 1
+        assert rt.target_index(live.NOTICE_GROW) is None  # already full
+        assert rt.target_index(live.NOTICE_NONE) is None
+        rt.index = 1
+        assert rt.target_index(live.NOTICE_SHRINK) is None  # already small
+        assert rt.target_index(live.NOTICE_GROW) == 0
+
+
+# ---------------------------------------------------------------------------
+# the state move is bit-lossless (both directions, all ZeRO stages)
+# ---------------------------------------------------------------------------
+
+class TestLosslessMove:
+    """The drill's cross-arm trajectories can only be compared within a
+    reduction-order tolerance (a 1- vs 2-device data axis reduces the
+    global batch in different orders). The MOVE itself has no such excuse:
+    re-scattering the identical values onto another mesh must be
+    bit-for-bit, and in-process both sides are observable."""
+
+    # ZeRO-2/3 shard state over the data axis, which must stay > 1 — so
+    # those stages shrink 4 -> 2, while stage 1 covers the 2 -> 1 floor
+    @pytest.mark.parametrize("zero_stage,data,target",
+                             [(1, 2, 1), (2, 4, 2), (3, 4, 2)])
+    def test_shrink_then_grow_roundtrip_bit_exact(self, zero_stage, data,
+                                                  target):
+        _cfg_, rt = _runtime(zero_stage=zero_stage, data=data,
+                             target=target)
+        state = rt.pt.init(jax.random.key(0))
+        ref = jax.device_get(state)
+
+        moved = rt.switch(state, live.NOTICE_SHRINK)
+        assert rt.index == 1 and rt.switches == 1
+        assert rt.device_count == target
+        got = jax.device_get(moved)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)), ref, got)
+        # the moved tree really lives on the target submesh
+        for leaf in jax.tree_util.tree_leaves(moved):
+            assert len(leaf.sharding.device_set) <= target
+
+        back = rt.switch(moved, live.NOTICE_GROW)
+        assert rt.index == 0 and rt.switches == 2
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            ref, jax.device_get(back))
+
+    def test_zero_shrink_to_single_device_fails_loudly(self):
+        """A ZeRO >= 2 run cannot shrink onto a size-1 data axis (nothing
+        left to shard over) — the rules engine rejects the target surface
+        the first time it is built, which under --aot_warmup is at
+        STARTUP, never mid-run on a notice."""
+        _cfg_, rt = _runtime(zero_stage=2, data=2, target=1)
+        with pytest.raises(ValueError, match="zero_stage=2"):
+            rt.surface(1)
+
+    def test_switch_without_direction_change_is_identity(self):
+        _cfg_, rt = _runtime()
+        state = rt.pt.init(jax.random.key(0))
+        assert rt.switch(state, live.NOTICE_GROW) is state
+        assert rt.switches == 0
+
+
+# ---------------------------------------------------------------------------
+# warmup-plan naming (the contract the semantic tier pins)
+# ---------------------------------------------------------------------------
+
+class TestWarmupPlanNames:
+    def test_both_topologies_planned_with_suffixes(self):
+        from dcgan_tpu.train import warmup
+
+        _cfg_, rt = _runtime()
+        plan = rt.build_warmup_plan(warmup.state_example(rt.pt))
+        names = {n for n, _, _ in plan}
+        # launch rows keep plain names; target rows carry @t1x1
+        assert {"init", "train_step", "state_copy"} <= names
+        assert {"init@t1x1", "train_step@t1x1",
+                "state_copy@t1x1"} <= names
+        # no cross-contamination: every suffixed name is the target's
+        assert all(n.endswith("@t1x1") for n in names if "@t" in n)
+
+
+# ---------------------------------------------------------------------------
+# trainer integration (in-process, 8-device env: t8x1 <-> t4x1)
+# ---------------------------------------------------------------------------
+
+class TestTrainerSwitch:
+    # One persistent compile cache for the whole class: these tests all
+    # lower the same tiny model on the same t8x1/t4x1 meshes, so the
+    # first (AOT-warmed) test populates the cache and the rest
+    # deserialize instead of re-compiling — CPU compile time dominates
+    # this class otherwise. Cache HITS still count as compile REQUESTS,
+    # so the compile_requests_delta=0 assertions are unaffected.
+    @pytest.fixture(scope="class")
+    def shared_cache(self, tmp_path_factory):
+        return str(tmp_path_factory.mktemp("live_elastic_cc"))
+
+    def test_notice_switch_completes_with_zero_compile_requests(
+            self, tmp_path, capsys, shared_cache):
+        """THE acceptance criterion: a chaos preemption notice mid-run
+        shrinks the live mesh with compile-request delta == 0 (both
+        topologies AOT-warmed + primed up front) and the run completes."""
+        from dcgan_tpu.train.trainer import train
+
+        chaos.set_plan(chaos.FaultPlan(preempt_notice_at_step=2))
+        cfg = _cfg(tmp_path, elastic_target_devices=4, aot_warmup=True,
+                   compile_cache_dir=shared_cache)
+        state = train(cfg, synthetic_data=True, max_steps=4)
+        assert int(jax.device_get(state["step"])) == 4
+        out = capsys.readouterr().out
+        assert "live-elastic warmup primed" in out
+        switch = [l for l in out.splitlines()
+                  if "live elastic switch at step 2" in l]
+        assert switch and "t8x1 -> t4x1" in switch[0], out[-2000:]
+        assert "compile_requests_delta=0" in switch[0], switch[0]
+        # the event row landed, gated to the notified run
+        events = [json.loads(l) for l in
+                  open(os.path.join(cfg.checkpoint_dir, "events.jsonl"))]
+        live_rows = [e["values"] for e in events if e["kind"] == "scalars"
+                     and "elastic/live_switch_ms" in e["values"]]
+        assert live_rows and live_rows[-1]["elastic/live_target_mesh"] == 4.0
+        assert live_rows[-1]["elastic/live_notice_step"] == 2.0
+
+    def test_notice_file_switch_writes_ack(self, tmp_path, capsys,
+                                           shared_cache):
+        """The operational path end-to-end: a pre-existing touch file is
+        the notice, the switch consumes it and writes the ack record a
+        notifying scheduler polls for."""
+        from dcgan_tpu.train.trainer import train
+
+        notice = tmp_path / "notice"
+        notice.write_text("")
+        cfg = _cfg(tmp_path, elastic_target_devices=4,
+                   compile_cache_dir=shared_cache,
+                   elastic_notice_file=str(notice))
+        state = train(cfg, synthetic_data=True, max_steps=3)
+        assert int(jax.device_get(state["step"])) == 3
+        out = capsys.readouterr().out
+        # a notice waiting at launch fires at the step-0 boundary, before
+        # the first dispatch — the whole run trains on the target mesh
+        assert "live elastic switch at step 0: t8x1 -> t4x1" in out
+        assert not notice.exists()
+        assert (tmp_path / "notice.consumed").exists()
+        record = json.loads((tmp_path / "notice.ack").read_text())
+        assert record["verdict"] == "shrink"
+        assert record["target_mesh"] == "t4x1"
+        assert record["step"] == 0 and record["switch_ms"] > 0
+
+    def test_pipeline_gd_seam(self, tmp_path, capsys, shared_cache):
+        """--pipeline_gd composes: the in-flight G/D stack (sharded on the
+        OLD mesh) is drained at the boundary and the stage programs keep
+        dispatching on the new mesh."""
+        from dcgan_tpu.train.trainer import train
+
+        chaos.set_plan(chaos.FaultPlan(preempt_notice_at_step=2))
+        cfg = _cfg(tmp_path, elastic_target_devices=4, aot_warmup=True,
+                   compile_cache_dir=shared_cache, pipeline_gd=True)
+        state = train(cfg, synthetic_data=True, max_steps=4)
+        assert int(jax.device_get(state["step"])) == 4
+        out = capsys.readouterr().out
+        assert "live elastic switch at step 2: t8x1 -> t4x1" in out
+
+    def test_grow_notice_on_full_mesh_consumes_without_switch(
+            self, tmp_path, capsys, shared_cache):
+        from dcgan_tpu.train.trainer import train
+
+        chaos.set_plan(chaos.FaultPlan(grow_notice_at_step=2))
+        cfg = _cfg(tmp_path, elastic_target_devices=4,
+                   compile_cache_dir=shared_cache)
+        state = train(cfg, synthetic_data=True, max_steps=3)
+        assert int(jax.device_get(state["step"])) == 3
+        out = capsys.readouterr().out
+        assert "already on t8x1 — consumed, no switch" in out
+        assert "live elastic switch" not in out
+
+    def test_armed_but_unnotified_parity(self, tmp_path, shared_cache):
+        """Arming elasticity is free: with no notice, the armed run's
+        trajectory and event stream are indistinguishable from an unarmed
+        run — bit-equal final params, identical loss rows, identical
+        event-key sets, and no elastic/live_* key anywhere."""
+        from dcgan_tpu.train.trainer import train
+
+        def run(sub, **kw):
+            cfg = _cfg(tmp_path, checkpoint_dir=str(tmp_path / sub),
+                       compile_cache_dir=shared_cache, **kw)
+            state = train(cfg, synthetic_data=True, max_steps=3)
+            events = [json.loads(l) for l in
+                      open(os.path.join(cfg.checkpoint_dir,
+                                        "events.jsonl"))]
+            return state, events
+
+        st_armed, ev_armed = run("armed", elastic_target_devices=4)
+        st_off, ev_off = run("off")
+
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(jax.device_get(a)),
+                np.asarray(jax.device_get(b))), st_armed, st_off)
+
+        def keys(events):
+            return {k for e in events if e["kind"] == "scalars"
+                    for k in e["values"]}
+
+        def losses(events):
+            return {e["step"]: (e["values"]["d_loss"],
+                                e["values"]["g_loss"])
+                    for e in events if e["kind"] == "scalars"
+                    and "d_loss" in e["values"]}
+
+        assert keys(ev_armed) == keys(ev_off)
+        assert losses(ev_armed) == losses(ev_off)
+        assert not any(k.startswith("elastic/") for k in keys(ev_armed))
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder counter field
+# ---------------------------------------------------------------------------
+
+class TestCounterField:
+    def test_counter_snapshot_has_live_topology(self):
+        from dcgan_tpu.utils.metrics import CounterSnapshot
+
+        snap = CounterSnapshot()
+        assert snap.live_topology == 0
+        assert CounterSnapshot(live_topology=4).live_topology == 4

@@ -158,9 +158,16 @@ print("TRAIN_DONE step=%d" % int(jax.device_get(state["step"])), flush=True)
 
 def _state_sum(out: str) -> str:
     """The driver's STATE_SUM line (full-precision text — compared for
-    bit-exact equality, never parsed back into a float)."""
+    bit-exact equality where the contract supports it)."""
     return next(line for line in out.splitlines()
                 if line.startswith("STATE_SUM="))
+
+
+def _state_sum_value(out: str) -> float:
+    """The STATE_SUM line parsed back to a float — for the contracts that
+    compare across DIFFERENT reduction orders, where the right check is a
+    tight relative tolerance, not text equality."""
+    return float(_state_sum(out).split("=", 1)[1])
 
 
 def _run_train(extra: dict, *, max_steps: int, synthetic: bool = True,
@@ -941,12 +948,18 @@ MH_SCENARIOS = {
 # available on CPU: the shrink/grow pair keeps the MESH identical (2-way
 # "data" axis) and changes only the process census (2 proc x 1 dev <->
 # 1 proc x 2 dev), so the compiled SPMD programs — and therefore the
-# post-resume losses — must replay BIT-EXACTLY against a same-topology
-# control resume of the same checkpoint. `synthetic_global_stream` makes
-# the data stream layout-invariant (every process draws the full global
-# batch and cuts its block), which is what makes that comparison
-# meaningful. The scenarios live in the single-process matrix: each
-# orchestrates its own 2-process phases.
+# post-resume losses — replay against a same-topology control resume of
+# the same checkpoint to within reduction-order noise: the HLO is
+# identical, but the cross-PROCESS collective implementation may reduce
+# partials in a different order than the intra-process one, so individual
+# reduced scalars (a logged loss, the host-side param sum) can differ in
+# the last ulp — the diffs below use ulp-scale relative tolerances, not
+# text equality, and any REAL divergence (wrong batch, wrong shard, wrong
+# step) is orders of magnitude beyond them. `synthetic_global_stream`
+# makes the data stream layout-invariant (every process draws the full
+# global batch and cuts its block), which is what makes the comparison
+# meaningful at all. The scenarios live in the single-process matrix:
+# each orchestrates its own 2-process phases.
 
 #: knobs common to every elastic arm — scalar rows every step (the loss
 #: replay is diffed from events.jsonl), no periodic saves (one final save
@@ -1043,21 +1056,35 @@ def _elastic_scenario(root: str, *, shrink: bool) -> dict:
            f"{name}: control arm did not restore step 3: "
            f"{out_ctrl[-800:]}")
 
-    # bit-exact replay: the same mesh ran the same programs over the same
-    # (layout-invariant) batches — losses and final params must agree to
-    # the last bit, or the reshard changed the state it claimed to move
+    # loss replay: the same mesh ran the same programs over the same
+    # (layout-invariant) batches — losses must agree to ulp scale. Not
+    # text-exact: a loss reduced across PROCESSES (the 2-proc arm) may sum
+    # partials in a different order than the intra-process all-reduce, and
+    # float addition does not associate, so single-ulp diffs in a logged
+    # scalar are legitimate (observed: g_loss, one ulp, grow direction).
+    # 1e-6 relative is ~10 ulps of float32 — far above that noise, far
+    # below any real divergence (wrong batch/shard/step shifts losses at
+    # the 1e-2 scale here).
     lx, lc = _loss_rows(_events(ck_cross)), _loss_rows(_events(ck_ctrl))
     for s in (4, 5, 6):
         _check(s in lx and s in lc,
                f"{name}: missing step-{s} loss row (cross has "
                f"{sorted(lx)}, control {sorted(lc)})")
-        _check(lx[s] == lc[s],
+        _check(all(abs(a - b) <= 1e-6 * max(abs(a), abs(b), 1e-3)
+                   for a, b in zip(lx[s], lc[s])),
                f"{name}: step-{s} losses diverged across topologies: "
                f"cross {lx[s]} != control {lc[s]}")
-    sum_cross, sum_ctrl = _state_sum(out_cross), _state_sum(out_ctrl)
-    _check(sum_cross == sum_ctrl,
-           f"{name}: post-resume states diverged: {sum_cross} != "
-           f"{sum_ctrl}")
+    # final params, same root cause wider window: the driver's host-side
+    # STATE_SUM accumulates ~75 gathered leaves whose low-bit history
+    # includes every boundary-order difference of the run, so it gets a
+    # looser (still tiny) tolerance; 5e-4 is ~100x the observed drift and
+    # far below any real state divergence.
+    sum_cross = _state_sum_value(out_cross)
+    sum_ctrl = _state_sum_value(out_ctrl)
+    rel = abs(sum_cross - sum_ctrl) / max(abs(sum_ctrl), 1e-30)
+    _check(rel <= 5e-4,
+           f"{name}: post-resume states diverged beyond reduction-order "
+           f"noise: {sum_cross!r} vs {sum_ctrl!r} (rel={rel:.2e})")
 
     # key gating: the reshard event surfaces elastic/*; the control stream
     # stays byte-identical in KEY SET to a pre-elastic resume
@@ -1074,25 +1101,157 @@ def _elastic_scenario(root: str, *, shrink: bool) -> dict:
            f"{name}: elastic row does not record the host-staged path: "
            f"{row}")
     return {"direction": "2proc->1proc" if shrink else "1proc->2proc",
-            "final_step": 6, "replay_bit_exact": True,
+            "final_step": 6, "replay_within_tolerance": True,
+            "state_sum_rel": rel,
             "reshard_ms": round(row["perf/restore/reshard_ms"], 1),
             "state_sum": sum_cross}
 
 
 def scenario_elastic_shrink(root: str) -> dict:
     """2-process save -> 1-process (2-device) resume: the preemptible-
-    fleet shrink. Bit-exact loss replay vs a 2-process control resume."""
+    fleet shrink. Ulp-tolerance loss replay vs a 2-process control
+    resume."""
     return _elastic_scenario(root, shrink=True)
 
 
 def scenario_elastic_grow(root: str) -> dict:
     """1-process (2-device) save -> 2-process resume: scale back out after
-    a degraded period. Bit-exact loss replay vs a 1-process control."""
+    a degraded period. Ulp-tolerance loss replay vs a 1-process control."""
     return _elastic_scenario(root, shrink=False)
 
 
 SCENARIOS["elastic-shrink"] = scenario_elastic_shrink
 SCENARIOS["elastic-grow"] = scenario_elastic_grow
+
+
+# -- live in-run elasticity (ISSUE 18, dcgan_tpu/elastic/live.py) ------------
+#
+# No restart in these drills: ONE trainer process with two virtual devices
+# receives a chaos preemption notice mid-run and switches its live mesh
+# (t2x1 -> t1x1, and back on a grow notice) at a step boundary. The
+# contract stack, strongest first:
+#   1. pre-notice losses replay BIT-EXACTLY against an armed-but-unnotified
+#      control (same config, no fault) — arming elasticity is free;
+#   2. the switch dispatches only warmup-cached executables:
+#      compile_requests_delta=0 printed on the switch line (a persistent
+#      compile cache is configured so the delta is measured, not assumed);
+#   3. post-switch the run COMPLETES, and the final params stay within the
+#      same reduction-order tolerance as the restart-based arms above —
+#      a 1-device and a 2-device data axis reduce the global batch in
+#      different orders, so post-switch trajectories are near, not equal
+#      (the state MOVE itself is bit-lossless — pinned in-process by
+#      tests/test_live_elastic.py, where both sides are observable);
+#   4. elastic/live_* event keys appear ONLY in the notified run.
+
+#: the live-elastic arm's extra knobs: elasticity armed at 1 device,
+#: AOT warmup on (the switch contract is warm-both-topologies), metrics
+#: every step for the loss diff
+def _live_knobs(root: str, ck: str) -> dict:
+    return dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+                compile_cache_dir=os.path.join(root, "cache"),
+                elastic_target_devices=1, aot_warmup=True,
+                **_ELASTIC_KNOBS)
+
+
+def _run_live(root: str, ck: str, *, chaos: dict = None):
+    rc, out = _run_train(_live_knobs(root, ck), max_steps=6, chaos=chaos,
+                         env_extra=_TWO_DEV_ENV)
+    _check(rc == 0, f"live trainer failed (rc={rc}): {out[-800:]}")
+    _check("TRAIN_DONE step=6" in out,
+           f"live run did not reach step 6: {out[-400:]}")
+    _check("live-elastic warmup primed" in out,
+           f"live run did not prime both topologies: {out[-800:]}")
+    return out
+
+
+def _switch_line(out: str, step: int, arrow: str) -> str:
+    want = f"live elastic switch at step {step}: {arrow}"
+    line = next((ln for ln in out.splitlines() if want in ln), None)
+    _check(line is not None,
+           f"no '{want}' line in output: {out[-800:]}")
+    _check("compile_requests_delta=0" in line,
+           f"switch at step {step} compiled something: {line}")
+    return line
+
+
+def _live_compare(name: str, ck_fault: str, ck_ctrl: str,
+                  out_fault: str, out_ctrl: str) -> float:
+    lf, lc = _loss_rows(_events(ck_fault)), _loss_rows(_events(ck_ctrl))
+    for s in (1, 2, 3):
+        _check(s in lf and s in lc,
+               f"{name}: missing step-{s} loss row (fault has "
+               f"{sorted(lf)}, control {sorted(lc)})")
+        _check(lf[s] == lc[s],
+               f"{name}: PRE-notice step-{s} losses diverged — arming "
+               f"elasticity must be free: {lf[s]} != {lc[s]}")
+    sum_f, sum_c = _state_sum_value(out_fault), _state_sum_value(out_ctrl)
+    rel = abs(sum_f - sum_c) / max(abs(sum_c), 1e-30)
+    _check(rel <= 5e-4,
+           f"{name}: post-switch state outside reduction-order tolerance: "
+           f"{sum_f!r} vs {sum_c!r} (rel={rel:.2e})")
+    live_rows = [e for e in _events(ck_fault) if e["kind"] == "scalars"
+                 and "elastic/live_switch_ms" in e["values"]]
+    _check(live_rows, f"{name}: no elastic/live_* event row in the "
+                      "notified run's stream")
+    ctrl_rows = [e for e in _events(ck_ctrl) if e["kind"] == "scalars"
+                 and any(k.startswith("elastic/live_")
+                         for k in e["values"])]
+    _check(not ctrl_rows, f"{name}: elastic/live_* keys leaked into the "
+                          f"unnotified control: {ctrl_rows[:1]}")
+    return rel
+
+
+def scenario_live_notice_shrink(root: str) -> dict:
+    """Chaos preemption notice at step 3 -> live t2x1 -> t1x1 switch, no
+    restart; completes to step 6 with zero compile requests across the
+    switch, vs an armed-but-unnotified control."""
+    out_ctrl = _run_live(root, os.path.join(root, "ck-control"))
+    _check("live elastic switch" not in out_ctrl,
+           f"control switched without a notice: {out_ctrl[-800:]}")
+    ck = os.path.join(root, "ck")
+    out = _run_live(root, ck, chaos={"preempt_notice_at_step": 3})
+    _switch_line(out, 3, "t2x1 -> t1x1")
+    rel = _live_compare("notice-shrink", ck,
+                        os.path.join(root, "ck-control"), out, out_ctrl)
+    row = [e for e in _events(ck) if e["kind"] == "scalars"
+           and "elastic/live_switch_ms" in e["values"]][-1]["values"]
+    _check(row["elastic/live_target_mesh"] == 1.0,
+           f"live event row does not record the 1-device target: {row}")
+    return {"final_step": 6, "compile_requests_delta": 0,
+            "switch_ms": round(row["elastic/live_switch_ms"], 1),
+            "state_sum_rel": rel}
+
+
+def scenario_live_grow_back(root: str) -> dict:
+    """Shrink notice at step 3 + grow notice at step 5: t2x1 -> t1x1 ->
+    t2x1 in one uninterrupted run, both switches compile-free. The t1x1
+    leg (steps 4-5) must replay BIT-EXACTLY against a shrink-only run —
+    the grow-back surface was warmed at startup, and being ABLE to grow
+    must not perturb the shrunken trajectory."""
+    out_ctrl = _run_live(root, os.path.join(root, "ck-control"))
+    ck_s = os.path.join(root, "ck-shrink")
+    out_s = _run_live(root, ck_s, chaos={"preempt_notice_at_step": 3})
+    ck = os.path.join(root, "ck")
+    out = _run_live(root, ck, chaos={"preempt_notice_at_step": 3,
+                                     "grow_notice_at_step": 5})
+    _switch_line(out, 3, "t2x1 -> t1x1")
+    _switch_line(out, 5, "t1x1 -> t2x1")
+    rel = _live_compare("grow-back", ck, os.path.join(root, "ck-control"),
+                        out, out_ctrl)
+    lg, ls = _loss_rows(_events(ck)), _loss_rows(_events(ck_s))
+    for s in (4, 5):
+        _check(s in lg and s in ls,
+               f"grow-back: missing step-{s} loss row (grow has "
+               f"{sorted(lg)}, shrink-only {sorted(ls)})")
+        _check(lg[s] == ls[s],
+               f"grow-back: shrunken-leg step-{s} losses diverged from "
+               f"the shrink-only run: {lg[s]} != {ls[s]}")
+    return {"final_step": 6, "switches": 2, "compile_requests_delta": 0,
+            "shrunken_leg_bit_exact": True, "state_sum_rel": rel}
+
+
+SCENARIOS["notice-shrink"] = scenario_live_notice_shrink
+SCENARIOS["grow-back"] = scenario_live_grow_back
 
 
 def main(argv=None) -> int:
