@@ -140,6 +140,9 @@ class Config:
         "dcgan_tpu/train/coordination.py",
         "dcgan_tpu/serve/server.py",
         "dcgan_tpu/serve/__main__.py",
+        # fleet report rows (ISSUE 19): serve/fleet_* and the drop split
+        "dcgan_tpu/serve/fleet.py",
+        "dcgan_tpu/serve/router.py",
         # emits the progressive/* scalar-row extras (ISSUE 15)
         "dcgan_tpu/progressive/phases.py",
     )

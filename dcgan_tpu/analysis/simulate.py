@@ -135,6 +135,15 @@ class Knobs:
                                        # drain->reshard->snapshot switch
                                        # sequence (notices land through
                                        # FaultPlan preempt/grow fields)
+    fleet_replicas: int = 0            # >0: run the SERVING-FLEET program
+                                       # (ISSUE 19) instead of the virtual
+                                       # trainer — each virtual process is
+                                       # one replica's dispatch thread;
+                                       # must equal n_proc
+    fleet_promote_at: int = 0          # >0: a fleet-wide weight promotion
+                                       # after this dispatch index — the
+                                       # drain->swap->prime->resume
+                                       # lattice rows
 
     def to_json(self) -> Dict[str, object]:
         d = dataclasses.asdict(self)
@@ -776,6 +785,80 @@ def _virtual_trainer(mesh: VirtualMesh, pid: int, knobs: Knobs,
         else f"completed@{step_num}"
 
 
+def _fleet_health_view(knobs: Knobs, fault: Optional[Fault]) -> dict:
+    """The promotion controller's health view at the promote boundary,
+    derived deterministically from the fault: replica r has been drained
+    from rotation iff its plan kills or hangs it STRICTLY BEFORE the
+    boundary dispatch (the heartbeat monitor needs one beat interval to
+    notice a death — a replica dying exactly AT the boundary is still
+    seen healthy, which is the stale-health-view lattice row)."""
+    health = {}
+    for r in range(knobs.n_proc):
+        plan = fault.plan_for(r) if fault is not None else None
+        dead_at = 0
+        if plan is not None:
+            hits = [d for d in (plan.replica_kill_at_dispatch,
+                                plan.replica_hang_at_dispatch) if d]
+            dead_at = min(hits) if hits else 0
+        health[r] = not (dead_at and dead_at < knobs.fleet_promote_at)
+    return health
+
+
+def _virtual_fleet(mesh: "VirtualMesh", pid: int, knobs: Knobs,
+                   plan, fault: Optional[Fault] = None) -> str:
+    """The serving-fleet protocol skeleton (ISSUE 19): each virtual
+    process is one replica's dispatch thread working through
+    `total_steps` dispatches, with a fleet-wide weight promotion after
+    dispatch `fleet_promote_at`.
+
+    The promotion targets come from the REAL decision code —
+    serve/router.promotion_targets over a health view derived from the
+    fault (see _fleet_health_view) — and the drain->swap->prime->resume
+    sequence is the REAL serve/fleet.PROMOTION_SEQUENCE, so a regression
+    in either shows up here as lock drift or a structural deadlock:
+
+    - targets == every replica: the promotion is modeled as a barrier
+      per phase (the all-healthy rendezvous). If promotion_targets ever
+      regressed to include a replica the fault killed, the survivors
+      block at promote-drain forever -> structural deadlock / watchdog
+      trip instead of the committed "done" schedules.
+    - targets excludes dead replicas: survivors promote replica-locally
+      (the real fleet never holds a cross-replica barrier once a peer is
+      drained — each surviving worker drains and swaps independently).
+    - a kill exactly AT the boundary: the controller's view is stale
+      (all replicas look healthy), survivors enter the phase barrier,
+      the dead replica never arrives -> the committed watchdog-trip row,
+      mirroring fleet.promote()'s bounded ticket waits.
+    """
+    from dcgan_tpu.serve.fleet import PROMOTION_SEQUENCE
+    from dcgan_tpu.serve.router import promotion_targets
+
+    targets = promotion_targets(_fleet_health_view(knobs, fault))
+    all_healthy = len(targets) == knobs.n_proc
+    for d in range(1, knobs.total_steps + 1):
+        # replica faults fire BEFORE the dispatch they are armed at
+        # (chaos hook placement in serve/worker.ServeWorker._run)
+        if plan and plan.replica_kill_at_dispatch \
+                and d >= plan.replica_kill_at_dispatch \
+                and plan.fire_once("replica_kill_at_dispatch"):
+            mesh.hang(f"hang-replica-kill@{d}")
+        if plan and plan.replica_hang_at_dispatch \
+                and d >= plan.replica_hang_at_dispatch \
+                and plan.fire_once("replica_hang_at_dispatch"):
+            mesh.hang(f"hang-replica-hang@{d}")
+        mesh.local(f"dispatch@{d}")
+        if knobs.fleet_promote_at and d == knobs.fleet_promote_at:
+            for phase in PROMOTION_SEQUENCE:
+                if all_healthy:
+                    mesh.collective("bar", f"promote-{phase}@{d}")
+                else:
+                    mesh.local(f"promote-{phase}@{d}")
+    tag = f"served@{knobs.total_steps}"
+    if knobs.fleet_promote_at:
+        tag += f"+promoted[{','.join(str(t) for t in targets)}]"
+    return tag
+
+
 def _virtual_process_main(mesh: VirtualMesh, pid: int, fn: Callable[[], str]
                           ) -> None:
     """Thread body for one virtual process: each sim thread IS the
@@ -824,6 +907,13 @@ def run_scenario(knobs: Knobs, fault: Fault,
                 if program is not None:
                     fn = (lambda p=pid, f=fault:
                           program(mesh, p, knobs, f.plan_for(p)))
+                elif knobs.fleet_replicas:
+                    # the fleet program needs the FULL fault (not just
+                    # its own plan) to derive the controller's health
+                    # view deterministically
+                    fn = (lambda p=pid, f=fault:
+                          _virtual_fleet(mesh, p, knobs, f.plan_for(p),
+                                         fault=f))
                 else:
                     fn = (lambda p=pid, f=fault, c=ckpt:
                           _virtual_trainer(mesh, p, knobs, f.plan_for(p),
@@ -897,6 +987,18 @@ def configs() -> List[Knobs]:
         Knobs("live-elastic-switch", nan_policy="rollback",
               nan_check_steps=1, live_elastic=True,
               pipeline_gd=True, aot_warmup=True),
+        # serving-fleet promotion drain (ISSUE 19): three replica
+        # dispatch threads, a fleet-wide weight promotion after dispatch
+        # 3. promotion_targets (the REAL router decision code) must
+        # exclude every replica the heartbeat monitor has drained — the
+        # lattice proves drain->swap->prime->resume completes under
+        # replica kills/hangs before, at, and after the boundary, and
+        # that the one genuinely racy shape (a kill exactly AT the
+        # boundary, stale health view) resolves as a bounded watchdog
+        # trip, never a silent wedge
+        Knobs("fleet-promotion", n_proc=3, total_steps=6,
+              nan_check_steps=100, fleet_replicas=3, fleet_promote_at=3,
+              collective_timeout_secs=8.0),
     ]
 
 
@@ -907,6 +1009,41 @@ def faults_for(k: Knobs) -> List[Fault]:
     actually fires; sigterm excluded under coord_stop=False multi-host
     (no handler installed there — see the module docstring)."""
     F = Fault.make
+    if k.fleet_replicas:
+        # serving-fleet configs run the replica-fault lattice only: the
+        # trainer faults (nan/sigterm/io) have no hook sites in the
+        # fleet program. `p` is the promotion boundary; kills strictly
+        # before it are drained (survivors promote locally), a kill
+        # exactly AT it is the stale-health-view watchdog row, kills
+        # after it die post-swap. The slow-beat fault is deliberately
+        # excluded — it is timing-dependent recovery, not protocol
+        # structure (covered by tools/chaos_drill.py instead).
+        p = k.fleet_promote_at
+        out = [F("clean")]
+        if p and k.n_proc >= 3:
+            out += [
+                F(f"replica-kill@r1@{p - 2}",
+                  {1: {"fault_replica": 1,
+                       "replica_kill_at_dispatch": p - 2}}),
+                F(f"replica-kill@r0@{p - 1}",
+                  {0: {"fault_replica": 0,
+                       "replica_kill_at_dispatch": p - 1}}),
+                F(f"replica-hang@r2@{p - 1}",
+                  {2: {"fault_replica": 2,
+                       "replica_hang_at_dispatch": p - 1}}),
+                F(f"replica-kill@r1@{p}",
+                  {1: {"fault_replica": 1,
+                       "replica_kill_at_dispatch": p}}),
+                F(f"replica-kill@r1@{p + 2}",
+                  {1: {"fault_replica": 1,
+                       "replica_kill_at_dispatch": p + 2}}),
+                F(f"replica-kill@r1@{p - 2}+r2@{p - 1}",
+                  {1: {"fault_replica": 1,
+                       "replica_kill_at_dispatch": p - 2},
+                   2: {"fault_replica": 2,
+                       "replica_kill_at_dispatch": p - 1}}),
+            ]
+        return out
     gate = k.nan_check_steps if k.nan_check_steps <= k.total_steps else 0
     out = [F("clean")]
     if gate:

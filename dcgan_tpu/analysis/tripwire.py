@@ -50,7 +50,17 @@ class ThreadDisciplineError(AssertionError):
 
 _installed = False
 _wrapped_count = 0
-_dispatch_thread: Optional[threading.Thread] = None
+#: the set of threads currently inside a dispatch_scope(). A SET, not a
+#: single slot: the serving fleet (ISSUE 19) runs N replica dispatch
+#: threads in one process, each a legitimate owner of ITS replica's
+#: programs — a single global owner would make replica A's collectives
+#: trip the moment replica B entered its scope. Membership is per-thread
+#: (add on enter, remove on outermost exit), guarded by _owners_lock;
+#: check() reads the set without the lock (a stale read can only happen
+#: during scope enter/exit, where the caller by definition owns or owned
+#: the scope).
+_dispatch_owners: set = set()
+_owners_lock = threading.Lock()
 
 #: coordination-module collective entry points install() wraps. Module
 #: constant (not an install()-local literal) because the semantic tier
@@ -71,37 +81,51 @@ def installed() -> bool:
 
 
 def check(what: str) -> None:
-    """Assert the caller is the dispatch thread (no-op outside an active
-    dispatch_scope — tools and tests own their single thread)."""
-    owner = _dispatch_thread
-    if owner is None:
+    """Assert the caller is a dispatch thread (no-op while no
+    dispatch_scope is active — tools and tests own their single
+    thread)."""
+    owners = _dispatch_owners
+    if not owners:
         return
     cur = threading.current_thread()
-    if cur is not owner:
+    if cur not in owners:
+        names = sorted(t.name for t in owners)
         raise ThreadDisciplineError(
             f"collective entry point {what!r} called from thread "
-            f"{cur.name!r} while the dispatch thread is {owner.name!r} — "
-            "mesh-wide collectives must stay on the dispatch thread "
-            "(DESIGN.md §6b): a background thread's collectives have no "
-            "cross-process ordering against the dispatch stream and two "
-            "processes interleaving them differently deadlock the mesh")
+            f"{cur.name!r} while the dispatch thread owner(s) are "
+            f"{names} — mesh-wide collectives must stay on the dispatch "
+            "thread (DESIGN.md §6b): a background thread's collectives "
+            "have no cross-process ordering against the dispatch stream "
+            "and two processes interleaving them differently deadlock "
+            "the mesh")
+
+
+def dispatch_owners() -> frozenset:
+    """The current dispatch-scope owner threads (empty = no active
+    scope). Read surface for tests; never mutate through this."""
+    return frozenset(_dispatch_owners)
 
 
 @contextlib.contextmanager
 def dispatch_scope():
-    """Mark the current thread as THE dispatch thread for the duration
-    (re-entrant: restores the previous owner on exit). trainer.train()
-    wraps its whole run in this; a no-op when the tripwire is off."""
-    global _dispatch_thread
+    """Mark the current thread as A dispatch thread for the duration
+    (re-entrant per thread: the outermost exit removes it). Each scoped
+    thread is an independent owner — trainer.train() scopes its calling
+    thread, and every serve replica's worker scopes its own dispatch
+    thread. A no-op when the tripwire is off."""
     if not _installed:
         yield
         return
-    prev = _dispatch_thread
-    _dispatch_thread = threading.current_thread()
+    cur = threading.current_thread()
+    with _owners_lock:
+        already_owner = cur in _dispatch_owners
+        _dispatch_owners.add(cur)
     try:
         yield
     finally:
-        _dispatch_thread = prev
+        if not already_owner:
+            with _owners_lock:
+                _dispatch_owners.discard(cur)
 
 
 class _GuardedFn:

@@ -14,8 +14,12 @@ Layers:
 - sources.py  — where weights come from: a checkpoint (single-pass
                 verified restore) or a `.jaxexport` artifact + sidecar
 - server.py   — queue, batcher, backpressure, latency accounting
-- worker.py   — the dispatch thread (cold start + batch loop + drain)
-- __main__.py — `python -m dcgan_tpu.serve` entry point
+- worker.py   — the dispatch thread (cold start + batch loop + drain +
+                weight promotion)
+- router.py   — fleet routing: least-queue-depth dispatch, heartbeat
+                health, hedge-once failover (ISSUE 19)
+- fleet.py    — N replicas + router + live checkpoint promotion
+- __main__.py — `python -m dcgan_tpu.serve` entry point (`--fleet N`)
 """
 
 from dcgan_tpu.serve.buckets import (  # noqa: F401
@@ -25,7 +29,17 @@ from dcgan_tpu.serve.buckets import (  # noqa: F401
     parse_buckets,
     sampler_plan,
 )
+from dcgan_tpu.serve.fleet import (  # noqa: F401
+    PROMOTION_SEQUENCE,
+    ServeFleet,
+)
+from dcgan_tpu.serve.router import (  # noqa: F401
+    Router,
+    RouterError,
+    promotion_targets,
+)
 from dcgan_tpu.serve.server import (  # noqa: F401
+    PromotionTicket,
     Response,
     SamplerServer,
     ServeError,
@@ -34,4 +48,5 @@ from dcgan_tpu.serve.server import (  # noqa: F401
 from dcgan_tpu.serve.sources import (  # noqa: F401
     ArtifactSource,
     CheckpointSource,
+    latest_finalized_step,
 )

@@ -75,6 +75,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--compile_cache_dir", default="",
                    help="persistent compile cache (warm restarts "
                         "deserialize the bucket programs)")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="run N health-checked replicas behind the "
+                        "failover router (0 = single bare server)")
+    p.add_argument("--heartbeat_secs", type=float, default=0.25,
+                   help="fleet health-poll cadence")
+    p.add_argument("--miss_beats", type=int, default=4,
+                   help="consecutive silent health polls before a "
+                        "replica is drained from rotation")
+    p.add_argument("--watch_promotions", action="store_true",
+                   help="fleet mode: watch the checkpoint dir for newly "
+                        "finalized steps and hot-swap weights live "
+                        "(zero recompiles, zero dropped requests)")
+    p.add_argument("--watch_interval_secs", type=float, default=0.5,
+                   help="promotion-watcher poll interval")
     p.add_argument("--trace", default=None,
                    help="JSON arrival trace to replay: {\"arrivals\": "
                         "[{\"t_ms\": ..., \"num_images\": ...}, ...]}")
@@ -128,22 +142,40 @@ def main(argv: Optional[List[str]] = None) -> int:
     tripwire.maybe_install()  # DCGAN_THREAD_CHECKS=1 honors the drill env
     from dcgan_tpu.config import MODEL_OVERRIDE_FLAGS
     from dcgan_tpu.serve.buckets import parse_buckets
+    from dcgan_tpu.serve.fleet import ServeFleet
     from dcgan_tpu.serve.server import SamplerServer
     from dcgan_tpu.serve.sources import ArtifactSource, CheckpointSource
 
-    if args.artifact:
-        source = ArtifactSource(args.artifact)
-    else:
-        source = CheckpointSource(
+    def _make_source():
+        if args.artifact:
+            return ArtifactSource(args.artifact)
+        return CheckpointSource(
             args.checkpoint_dir, use_ema=args.use_ema, preset=args.preset,
             overrides={n: getattr(args, n) for n in MODEL_OVERRIDE_FLAGS},
             max_batch=args.max_batch, quantize=args.quantize)
+
     ladder = parse_buckets(args.buckets) if args.buckets else None
-    server = SamplerServer(source, ladder=ladder, max_batch=args.max_batch,
-                           max_queue=args.max_queue,
-                           max_wait_ms=args.max_wait_ms,
-                           cache_dir=args.compile_cache_dir,
-                           seed=args.seed)
+    fleet_n = max(0, args.fleet)
+    fleet = None
+    if fleet_n:
+        fleet = ServeFleet(
+            [_make_source() for _ in range(fleet_n)],
+            buckets=(ladder.buckets if ladder is not None else None),
+            max_batch=args.max_batch, max_queue=args.max_queue,
+            max_wait_ms=args.max_wait_ms,
+            cache_dir=args.compile_cache_dir, seed=args.seed,
+            heartbeat_secs=args.heartbeat_secs,
+            miss_beats=args.miss_beats,
+            watch_promotions=args.watch_promotions,
+            watch_interval_secs=args.watch_interval_secs)
+        server = fleet.servers[0]   # banner/cold-start reporting
+    else:
+        server = SamplerServer(_make_source(), ladder=ladder,
+                               max_batch=args.max_batch,
+                               max_queue=args.max_queue,
+                               max_wait_ms=args.max_wait_ms,
+                               cache_dir=args.compile_cache_dir,
+                               seed=args.seed)
 
     # graceful drain on SIGTERM/SIGINT: the handler only flips a flag —
     # the main thread breaks out of the load loop and runs the drain
@@ -158,7 +190,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGINT, _on_signal)
 
     t0 = time.perf_counter()
-    meta = server.start()
+    if fleet is not None:
+        metas = fleet.start()
+        meta = metas[0]
+    else:
+        meta = server.start()
     cold = server.cold_ms
     cache_note = ""
     if server._monitor is not None:
@@ -181,9 +217,16 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"saved on {rs['saved_processes']} process(es) x "
               f"{rs['saved_devices']} device(s), resharded onto this "
               f"host's mesh in {rs['reshard_ms']:.0f} ms", flush=True)
+    if fleet is not None:
+        print(f"[dcgan_tpu.serve] fleet: {fleet_n} replica(s) warm, "
+              f"heartbeat {args.heartbeat_secs:.2f}s x "
+              f"{args.miss_beats} miss(es)"
+              + (", promotion watcher on" if args.watch_promotions
+                 else ""), flush=True)
     print("[dcgan_tpu.serve] warm: serving", flush=True)
 
     arrivals = _load_arrivals(args)
+    intake = fleet if fleet is not None else server
     responses = []
     submitted = 0
     t_load = time.monotonic()
@@ -193,16 +236,25 @@ def main(argv: Optional[List[str]] = None) -> int:
             break
         if stop_event.is_set():
             break
-        responses.append(server.submit(arrival["num_images"]))
+        if fleet is not None:
+            responses.append(fleet.submit(
+                arrival["num_images"],
+                client_id=arrival.get("client")))
+        else:
+            responses.append(server.submit(arrival["num_images"]))
         submitted += 1
     if not arrivals:
         # no load source: idle-serve until a signal arrives
         stop_event.wait()
 
     interrupted = stop_event.is_set()
-    server.stop(drain=True)
+    if fleet is not None:
+        fleet.stop(drain=True)
+    else:
+        server.stop(drain=True)
     completed = sum(1 for r in responses if r.done() and r.error is None)
-    report = server.report()
+    failed = sum(1 for r in responses if r.done() and r.error is not None)
+    report = intake.report()
     row = {
         "label": "serve-report",
         "buckets": list(server.ladder.buckets),
@@ -211,11 +263,25 @@ def main(argv: Optional[List[str]] = None) -> int:
         "submitted": submitted,
         "unsubmitted": len(arrivals) - submitted,
         "completed": completed,
+        "failed": failed,
         "interrupted": interrupted,
         "wall_s": round(time.perf_counter() - t0, 3),
         **{k: (round(v, 3) if isinstance(v, float) else v)
            for k, v in report.items()},
     }
+    if fleet is not None:
+        row["fleet"] = {
+            "replicas": fleet_n,
+            "unhealthy": [[i, reason] for i, reason
+                          in fleet.router.unhealthy_events],
+            "failovers": fleet.router.failovers,
+            "stop_errors": fleet.stop_errors,
+            "promotions": fleet.promotion_results,
+            "per_replica": [
+                {k: (round(v, 3) if isinstance(v, float) else v)
+                 for k, v in r.items()}
+                for r in fleet.per_replica_reports()],
+        }
     if args.report:
         with open(args.report, "w") as f:
             json.dump(row, f)

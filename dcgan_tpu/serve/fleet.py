@@ -1,0 +1,247 @@
+"""The serving fleet: N replicas, one router, live weight promotion.
+
+ISSUE 19 tentpole. A `ServeFleet` owns N in-process `SamplerServer`
+replicas — each with its OWN weight source, its own dispatch thread
+(worker.py, a declared dispatch-thread owner under DCG001), and its own
+AOT-primed bucket ladder — behind a `Router` (router.py) that
+dispatches by least queue depth, health-checks replicas via heartbeat,
+and fails over mid-flight requests onto healthy peers. One replica
+crash, hang, or overload sheds load instead of failing clients.
+
+Startup: replicas cold-start SEQUENTIALLY against a shared persistent
+compile cache — the first replica pays the bucket compiles, later ones
+hit the cache — and every replica's post-warmup compile-cache baseline
+is re-snapshotted once ALL replicas are warm (sequential starts land
+later replicas' cache requests after earlier snapshots; without the
+rebaseline those read as phantom recompiles).
+
+Promotion (`promote()`): the drain -> swap -> prime -> resume sequence
+(`PROMOTION_SEQUENCE`), targeted at exactly the healthy replicas
+(`router.promotion_targets` — the same decision function the protocol
+tier's virtual fleet drives, so the drain lattice's deadlock-freedom
+proof covers this code path). Each target replica hot-swaps behind its
+own dispatch thread's implicit drain barrier: the control op is popped
+only between batches, the reload restores the newest finalized step into
+the existing state template (same avals/shardings — PR 11 sidecar
+reshard included), and one throwaway dispatch per rung re-links the
+swapped weights through every cached executable (the PR 14 prime()
+trick) — zero dropped requests, zero recompiles, proven per replica by
+the CompileCacheMonitor request delta in the ticket result. Waits are
+per-ticket and bounded, never parked on a dead replica: an unhealthy
+replica is simply not in the target set.
+
+An optional watcher thread polls the checkpoint directory for a newly
+FINALIZED step (integer-named dir — the Orbax tmp+rename contract) and
+triggers `promote()` automatically: train-to-serve weight delivery with
+no restart and no client-visible blip.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from dcgan_tpu.serve.router import Router, promotion_targets
+from dcgan_tpu.serve.server import SamplerServer, ServeError
+
+#: the promotion drain lattice, in order. Shared with the protocol
+#: tier's virtual fleet (analysis/simulate.py) so the simulated barrier
+#: sequence and the real one cannot drift apart silently.
+PROMOTION_SEQUENCE = ("drain", "swap", "prime", "resume")
+
+#: counters summed across replicas in the fleet report
+_SUM_KEYS = ("serve/requests", "serve/completed", "serve/dropped",
+             "serve/dropped_overload", "serve/dropped_failover",
+             "serve/batches", "serve/images",
+             "serve/recompiles_after_warmup")
+
+
+class ServeFleet:
+    """N health-checked sampler replicas behind a failover router.
+
+    `sources` is one weight source PER replica (each replica restores
+    and serves its own copy — replica isolation is the point). Server
+    knobs are shared across replicas; `cache_dir` should be shared so
+    later replicas hit the first one's compiles.
+    """
+
+    def __init__(self, sources: Sequence, *,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_batch: int = 64,
+                 max_queue: int = 256,
+                 max_wait_ms: float = 10.0,
+                 cache_dir: str = "",
+                 seed: int = 0,
+                 heartbeat_secs: float = 0.25,
+                 miss_beats: int = 4,
+                 watch_promotions: bool = False,
+                 watch_interval_secs: float = 0.5):
+        if not sources:
+            raise ValueError("fleet needs at least one source")
+        self.servers = [
+            SamplerServer(src, buckets=buckets, max_batch=max_batch,
+                          max_queue=max_queue, max_wait_ms=max_wait_ms,
+                          cache_dir=cache_dir, seed=seed,
+                          replica_index=i)
+            for i, src in enumerate(sources)]
+        self.router = Router(self.servers,
+                             heartbeat_secs=heartbeat_secs,
+                             miss_beats=miss_beats)
+        self.watch_interval_secs = watch_interval_secs
+        self._watch = watch_promotions
+        self._watch_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._promotions = 0
+        self._promoted_step: Optional[int] = None
+        self.promotion_results: List[List[Dict[str, Any]]] = []
+        self.stop_errors: List = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, timeout: Optional[float] = None) -> List[dict]:
+        """Cold-start every replica sequentially (shared compile cache:
+        replica 0 pays the compiles), rebaseline all compile-cache
+        snapshots once the whole fleet is warm, then start the health
+        monitor and (optionally) the promotion watcher. Returns the
+        per-replica source metadata."""
+        metas = []
+        for s in self.servers:
+            metas.append(s.start(timeout))
+        for s in self.servers:
+            s._rebaseline_cache()
+        step = metas[0].get("step")
+        self._promoted_step = int(step) if step is not None else None
+        self.router.start_monitor()
+        if self._watch:
+            self._watch_thread = threading.Thread(
+                target=self._watch_loop, name="dcgan-serve-promoter",
+                daemon=True)
+            self._watch_thread.start()
+        return metas
+
+    def submit(self, num_images: int = 1, *, z=None, labels=None,
+               seed=None, client_id=None):
+        """Route one request through the fleet; see Router.submit."""
+        return self.router.submit(num_images, z=z, labels=labels,
+                                  seed=seed, client_id=client_id)
+
+    def stop(self, drain: bool = True, timeout: float = 120.0) -> List:
+        """Stop the watcher, the monitor, then every replica. A replica
+        that already died (chaos kill, poisoned worker) does not block
+        the others' drain: its stop error is COLLECTED into the returned
+        `stop_errors` list, not raised — the fleet's contract is zero
+        failed CLIENT requests, and those were already failed over."""
+        self._stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(5.0)
+            self._watch_thread = None
+        self.router.stop_monitor()
+        errors: List = []
+        for s in self.servers:
+            try:
+                s.stop(drain=drain, timeout=timeout)
+            except BaseException as e:  # noqa: BLE001 — collected
+                errors.append((s.replica_index, repr(e)))
+        self.stop_errors = errors
+        return errors
+
+    # -- promotion ----------------------------------------------------------
+
+    def promote(self, timeout: float = 300.0) -> List[Dict[str, Any]]:
+        """Hot-swap every HEALTHY replica to the newest finalized
+        checkpoint step. Targets come from `promotion_targets` over the
+        router's live health map — a dead replica is never in the set,
+        so the wait below can only block on replicas whose dispatch
+        threads are alive (and each wait is bounded anyway). Returns one
+        result dict per target: {replica, step, swap_ms,
+        compile_requests_delta} or {replica, error}."""
+        targets = promotion_targets(self.router.health())
+        if not targets:
+            raise ServeError("no healthy replicas to promote")
+        tickets = [(i, self.servers[i].request_promote())
+                   for i in targets]
+        results: List[Dict[str, Any]] = []
+        ok = 0
+        for i, t in tickets:
+            try:
+                results.append(t.result(timeout))
+                ok += 1
+            except BaseException as e:  # noqa: BLE001 — per-replica
+                results.append({"replica": i, "error": repr(e)})
+        if ok:
+            self._promotions += 1
+            good = [r for r in results if "error" not in r]
+            print(f"[dcgan_tpu] serve fleet: promoted "
+                  f"{len(good)}/{len(results)} replica(s) to step "
+                  f"{good[0].get('step')}", flush=True)
+        self.promotion_results.append(results)
+        return results
+
+    def _watch_loop(self) -> None:
+        """Poll the checkpoint directory for a newly finalized step and
+        promote when one lands. Probe errors read as 'nothing new'."""
+        probe = getattr(self.servers[0].source, "latest_step_on_disk",
+                        None)
+        if probe is None:
+            return
+        while not self._stop.wait(self.watch_interval_secs):
+            step = probe()
+            if step is None:
+                continue
+            if self._promoted_step is not None \
+                    and step <= self._promoted_step:
+                continue
+            try:
+                self.promote()
+            except ServeError:
+                continue  # no healthy replicas right now; retry later
+            self._promoted_step = step
+
+    # -- reporting ----------------------------------------------------------
+
+    def per_replica_reports(self) -> List[Dict[str, float]]:
+        return [s.report() for s in self.servers]
+
+    def report(self) -> Dict[str, float]:
+        """The fleet-level serve/* row: replica counters summed, latency
+        percentiles recomputed over the merged samples, plus the fleet
+        health/failover/promotion accounting."""
+        from dcgan_tpu.serve.server import _percentile
+
+        rows = self.per_replica_reports()
+        out: Dict[str, float] = {
+            k: float(sum(r.get(k, 0.0) for r in rows))
+            for k in _SUM_KEYS}
+        out["serve/queue_depth_max"] = float(max(
+            r.get("serve/queue_depth_max", 0.0) for r in rows))
+        padded = sum(s.padded_rows for s in self.servers)
+        dispatched = sum(s.dispatched_rows for s in self.servers)
+        out["serve/pad_frac"] = padded / max(1, dispatched)
+        lat = sorted(x for s in self.servers for x in s._latencies_ms)
+        if lat:
+            out["serve/p50_ms"] = _percentile(lat, 50.0)
+            out["serve/p99_ms"] = _percentile(lat, 99.0)
+        starts = [s._t_warm for s in self.servers
+                  if s._t_warm is not None]
+        ends = [s._t_drained for s in self.servers]
+        if starts:
+            end = max(e for e in ends if e is not None) \
+                if any(e is not None for e in ends) else time.monotonic()
+            span = end - min(starts)
+            if span > 0:
+                out["serve/samples_per_sec"] = \
+                    out["serve/images"] / span
+        out["serve/fleet_replicas"] = float(len(self.servers))
+        out["serve/fleet_unhealthy"] = float(len(
+            {i for i, _ in self.router.unhealthy_events}))
+        out["serve/fleet_failovers"] = float(self.router.failovers)
+        # fleet-level promote() rounds, or replica-level ticket counts
+        # when a caller promoted a single server directly
+        rounds = self._promotions or max(
+            (s.promotions for s in self.servers), default=0)
+        if rounds:
+            out["serve/promotions"] = float(rounds)
+            out["serve/promote_swap_ms"] = max(
+                s.promote_swap_ms for s in self.servers)
+        return out

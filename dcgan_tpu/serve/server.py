@@ -49,7 +49,16 @@ class ServeError(RuntimeError):
 
 
 class ServeOverloadError(ServeError):
-    """This request was shed by drop-oldest backpressure."""
+    """This request was shed by drop-oldest backpressure. Carries the
+    shed-time queue state so fleet shedding is attributable (ISSUE 19):
+    `queue_depth` is the pending-request count at shed time and
+    `oldest_wait_ms` how long the head of the queue had been waiting."""
+
+    def __init__(self, msg: str, *, queue_depth: int = 0,
+                 oldest_wait_ms: float = 0.0):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+        self.oldest_wait_ms = oldest_wait_ms
 
 
 class Response:
@@ -61,6 +70,8 @@ class Response:
 
     def __init__(self) -> None:
         self._ev = threading.Event()
+        self._cb_lock = threading.Lock()
+        self._cbs: List[Any] = []
         self.images: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
         self.meta: Dict[str, Any] = {}
@@ -75,11 +86,60 @@ class Response:
             raise self.error
         return self.images
 
+    def add_done_callback(self, fn) -> None:
+        """Run `fn(self)` when the response resolves or fails —
+        immediately (on the calling thread) if already done. Callbacks
+        run on the resolving thread; keep them cheap. This is the
+        router's failover hook: a replica death fails its in-flight
+        responses, and the callback re-routes them to a healthy peer."""
+        with self._cb_lock:
+            if not self._ev.is_set():
+                self._cbs.append(fn)
+                return
+        fn(self)
+
     # -- dispatch-thread side ---------------------------------------------
+
+    def _finish(self) -> None:
+        with self._cb_lock:
+            self._ev.set()
+            cbs, self._cbs = self._cbs, []
+        for fn in cbs:
+            fn(self)
 
     def _resolve(self, images: np.ndarray, meta: Dict[str, Any]) -> None:
         self.images = images
         self.meta.update(meta)
+        self._finish()
+
+    def _fail(self, err: BaseException) -> None:
+        self.error = err
+        self._finish()
+
+
+class PromotionTicket:
+    """Future-like handle for one weight-promotion control op. Resolved
+    by the replica's dispatch thread after the drain -> swap -> prime ->
+    resume sequence; `info` carries {step, swap_ms,
+    compile_requests_delta}."""
+
+    def __init__(self) -> None:
+        self._ev = threading.Event()
+        self.info: Dict[str, Any] = {}
+        self.error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._ev.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Dict[str, Any]:
+        if not self._ev.wait(timeout):
+            raise TimeoutError("promotion not resolved within timeout")
+        if self.error is not None:
+            raise self.error
+        return dict(self.info)
+
+    def _resolve(self, info: Dict[str, Any]) -> None:
+        self.info.update(info)
         self._ev.set()
 
     def _fail(self, err: BaseException) -> None:
@@ -153,7 +213,8 @@ class SamplerServer:
                  max_wait_ms: float = 10.0,
                  cache_dir: str = "",
                  seed: int = 0,
-                 registry=None):
+                 registry=None,
+                 replica_index: int = 0):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if max_wait_ms < 0:
@@ -165,6 +226,8 @@ class SamplerServer:
         self.max_wait_ms = max_wait_ms
         self.cache_dir = cache_dir
         self.seed = seed
+        self.replica_index = replica_index  # position in a ServeFleet
+                                            # (0 for a bare server)
         self._explicit_ladder = ladder
         self._explicit_buckets = tuple(buckets) if buckets else None
         self.ladder: Optional[BucketLadder] = None   # set at cold start
@@ -172,6 +235,8 @@ class SamplerServer:
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._queue: "collections.deque[_Pending]" = collections.deque()
+        self._control: "collections.deque[PromotionTicket]" = \
+            collections.deque()
         self._draining = False
         self._started = False
         self._ready = threading.Event()
@@ -183,12 +248,18 @@ class SamplerServer:
         # for telemetry)
         self.submitted = 0
         self.completed = 0
-        self.dropped = 0
+        self.dropped = 0            # total sheds (overload + failover)
+        self.dropped_overload = 0   # drop-oldest backpressure sheds
+        self.dropped_failover = 0   # router-abandoned during failover
         self.batches = 0
         self.images_out = 0
         self.padded_rows = 0
         self.dispatched_rows = 0
         self.queue_depth_max = 0
+        self.promotions = 0         # completed weight promotions
+        self.promote_swap_ms = 0.0  # last promotion's swap wall time
+        self.beats = 0              # dispatch-thread liveness heartbeat
+        self._beat_mute_until = 0.0
         self._serial = 0
         self._latencies_ms: List[float] = []
 
@@ -208,6 +279,10 @@ class SamplerServer:
         self.registry.provide("serve_requests", lambda: self.submitted)
         self.registry.provide("serve_completed", lambda: self.completed)
         self.registry.provide("serve_dropped", lambda: self.dropped)
+        self.registry.provide("serve_dropped_overload",
+                              lambda: self.dropped_overload)
+        self.registry.provide("serve_dropped_failover",
+                              lambda: self.dropped_failover)
         self.registry.provide("serve_batches", lambda: self.batches)
         self.registry.provide("serve_queue", lambda: len(self._queue))
 
@@ -255,38 +330,53 @@ class SamplerServer:
         if labels is not None and len(labels) != num_images:
             raise ValueError(
                 f"labels length {len(labels)} != num_images {num_images}")
+        # responses are failed OUTSIDE the lock: done-callbacks (router
+        # failover) may touch other servers or re-enter this one
+        fail_after: List[Tuple[Response, BaseException]] = []
         with self._lock:
             if self._draining or self._error is not None:
                 p = _Pending(num_images, z, labels, seed, -1)
-                p.resp._fail(ServeError(
+                fail_after.append((p.resp, ServeError(
                     "server is stopped" if self._error is None else
-                    f"server failed: {self._error!r}"))
-                return p.resp
-            p = _Pending(num_images, z, labels, seed, self._serial)
-            self._serial += 1
-            self.submitted += 1
-            overload = ServeOverloadError(
-                f"request shed by drop-oldest backpressure "
-                f"(queue full at {self.max_queue})")
-            while len(self._queue) >= self.max_queue:
-                # shed the oldest NEVER-DISPATCHED request: a partially
-                # dispatched head already has device work banked — failing
-                # it would throw those chunks away. With nothing
-                # undispatched to shed (max_queue=1 around a chunking
-                # head), the NEW request is the one rejected.
-                victim = next((q for q in self._queue if q.delivered == 0),
-                              None)
-                if victim is None:
+                    f"server failed: {self._error!r}")))
+            else:
+                p = _Pending(num_images, z, labels, seed, self._serial)
+                self._serial += 1
+                self.submitted += 1
+                rejected = False
+                while len(self._queue) >= self.max_queue:
+                    # shed the oldest NEVER-DISPATCHED request: a
+                    # partially dispatched head already has device work
+                    # banked — failing it would throw those chunks away.
+                    # With nothing undispatched to shed (max_queue=1
+                    # around a chunking head), the NEW request is the one
+                    # rejected.
+                    depth = len(self._queue)
+                    oldest_ms = (time.monotonic()
+                                 - self._queue[0].t_submit) * 1e3
+                    overload = ServeOverloadError(
+                        f"request shed by drop-oldest backpressure "
+                        f"(queue full at {self.max_queue}; depth {depth},"
+                        f" oldest waited {oldest_ms:.1f}ms)",
+                        queue_depth=depth, oldest_wait_ms=oldest_ms)
+                    victim = next(
+                        (q for q in self._queue if q.delivered == 0),
+                        None)
                     self.dropped += 1
-                    p.resp._fail(overload)
-                    return p.resp
-                self._queue.remove(victim)
-                self.dropped += 1
-                victim.resp._fail(overload)
-            self._queue.append(p)
-            self.queue_depth_max = max(self.queue_depth_max,
-                                       len(self._queue))
-            self._work.notify_all()
+                    self.dropped_overload += 1
+                    if victim is None:
+                        fail_after.append((p.resp, overload))
+                        rejected = True
+                        break
+                    self._queue.remove(victim)
+                    fail_after.append((victim.resp, overload))
+                if not rejected:
+                    self._queue.append(p)
+                    self.queue_depth_max = max(self.queue_depth_max,
+                                               len(self._queue))
+                    self._work.notify_all()
+        for resp, err in fail_after:
+            resp._fail(err)
         return p.resp
 
     def stop(self, drain: bool = True, timeout: float = 120.0) -> None:
@@ -296,15 +386,23 @@ class SamplerServer:
         A drain that outlives `timeout` raises TimeoutError — never a
         silent success banner over a still-running worker whose queued
         responses would die with the process."""
+        fail_after: List[Tuple[Any, BaseException]] = []
         with self._lock:
             if not self._started:
                 return
             self._draining = True
             if not drain:
+                err = ServeError("server stopped before dispatch")
                 while self._queue:
-                    self._queue.popleft().resp._fail(
-                        ServeError("server stopped before dispatch"))
+                    fail_after.append((self._queue.popleft().resp, err))
+                while self._control:
+                    fail_after.append((self._control.popleft(),
+                                       ServeError(
+                                           "server stopped before "
+                                           "promotion")))
             self._work.notify_all()
+        for fut, err in fail_after:
+            fut._fail(err)
         if self._worker is not None:
             self._worker.join(timeout)
             if self._worker.is_alive():
@@ -315,6 +413,67 @@ class SamplerServer:
         if self._monitor is not None:
             self._monitor.close()
         self.raise_if_failed()
+
+    def request_promote(self) -> PromotionTicket:
+        """Enqueue a weight-promotion control op; returns its ticket.
+        The dispatch thread processes control ops with priority over
+        pending requests — the in-flight batch completes first (the
+        drain barrier falls out of the worker's sequential loop), then
+        the worker swaps weights and re-primes every rung before serving
+        resumes. A stopped/poisoned server fails the ticket
+        immediately."""
+        t = PromotionTicket()
+        fail: Optional[BaseException] = None
+        with self._lock:
+            if self._draining or self._error is not None:
+                fail = ServeError(
+                    "server is stopped" if self._error is None else
+                    f"server failed: {self._error!r}")
+            else:
+                self._control.append(t)
+                self._work.notify_all()
+        if fail is not None:
+            t._fail(fail)
+        return t
+
+    def evict_pending(self) -> int:
+        """Health-monitor rescue: remove every UNTOUCHED pending request
+        (no span ever taken from it) and fail it with a retryable
+        ServeError, so router failover callbacks resubmit it to a
+        healthy peer. Requests a dispatch already took rows from stay —
+        the (possibly just slow) worker still holds references and will
+        resolve or fail them itself. Returns the eviction count."""
+        victims: List[Any] = []
+        with self._lock:
+            keep = [p for p in self._queue
+                    if p.remaining < p.num_images]
+            victims = [p.resp for p in self._queue
+                       if p.remaining == p.num_images]
+            self._queue.clear()
+            self._queue.extend(keep)
+        err = ServeError(
+            f"request evicted from unhealthy replica "
+            f"{self.replica_index}")
+        for resp in victims:
+            resp._fail(err)
+        return len(victims)
+
+    def record_failover_drop(self, n: int = 1) -> None:
+        """Router-side accounting: `n` requests parked on this replica
+        were abandoned during failover (no healthy peer could take
+        them). Kept separate from overload sheds so fleet drops stay
+        attributable."""
+        with self._lock:
+            self.dropped += n
+            self.dropped_failover += n
+
+    def queue_depth(self) -> int:
+        """Pending-request count (router's load signal)."""
+        return len(self._queue)
+
+    def poisoned(self) -> bool:
+        """Whether the dispatch thread died (permanent unhealth)."""
+        return self._error is not None
 
     def raise_if_failed(self) -> None:
         err = self._error
@@ -338,6 +497,8 @@ class SamplerServer:
                 "serve/requests": float(self.submitted),
                 "serve/completed": float(self.completed),
                 "serve/dropped": float(self.dropped),
+                "serve/dropped_overload": float(self.dropped_overload),
+                "serve/dropped_failover": float(self.dropped_failover),
                 "serve/batches": float(self.batches),
                 "serve/images": float(self.images_out),
                 "serve/queue_depth_max": float(self.queue_depth_max),
@@ -353,6 +514,9 @@ class SamplerServer:
             out["serve/p50_ms"] = _percentile(lat, 50.0)
             out["serve/p99_ms"] = _percentile(lat, 99.0)
             out["serve/mean_ms"] = float(np.mean(lat))
+        if self.promotions:
+            out["serve/promotions"] = float(self.promotions)
+            out["serve/promote_swap_ms"] = self.promote_swap_ms
         # explicit literals (not a prefix f-string) so DCG004 lints each
         # cold-start key against the inventory individually
         for key, src in (("serve/restore_ms", "restore_ms"),
@@ -396,13 +560,31 @@ class SamplerServer:
         return BucketLadder(buckets=tuple(sorted(set(rungs))),
                             granule=granule)
 
-    def _next_batch(self) -> Optional[Tuple[List[Tuple[_Pending, int]],
-                                            int]]:
-        """Block until a batch is due (full top bucket, deadline, or
-        drain), then pop it FIFO; None once draining and empty — the
-        worker's exit signal."""
+    def _bump_beat(self) -> None:
+        """Dispatch-thread liveness heartbeat: bumped on every batcher
+        wait iteration and after every dispatch. A wedged worker stops
+        bumping, which is exactly the signal the router's health monitor
+        watches. A chaos slow-beat fault mutes bumps until a deadline
+        (replica still serves but looks dead — the false-positive
+        path)."""
+        if time.monotonic() < self._beat_mute_until:
+            return
+        self.beats += 1
+
+    def _mute_beats(self, secs: float) -> None:
+        self._beat_mute_until = time.monotonic() + secs
+
+    def _next_batch(self):
+        """Block until work is due, then return it: a PromotionTicket
+        (control ops take priority — the in-flight batch already
+        finished, so this IS the drain barrier), a `(spans, total)`
+        request batch (full top bucket, deadline, or drain), or None
+        once draining and empty — the worker's exit signal."""
         with self._lock:
             while True:
+                self._bump_beat()
+                if self._control:
+                    return self._control.popleft()
                 if not self._queue:
                     if self._draining:
                         self._t_drained = time.monotonic()
@@ -443,13 +625,29 @@ class SamplerServer:
             self.images_out += p.num_images
             self._latencies_ms.append(total_ms)
 
+    def _rebaseline_cache(self) -> None:
+        """Re-snapshot the post-warmup compile-cache baseline. The fleet
+        start path calls this on every replica after ALL replicas are
+        warm: sequential cold starts land later replicas' cache requests
+        after earlier replicas' snapshots, which would otherwise read as
+        phantom recompiles in `serve/recompiles_after_warmup`."""
+        if self._monitor is not None:
+            self._cache_post_warmup = dict(self._monitor.counters())
+
     def _fail_all(self, err: BaseException) -> None:
-        """Worker death: fail everything still queued, poison intake."""
+        """Worker death: fail everything still queued (requests AND
+        pending promotions), poison intake. Responses fail outside the
+        lock so router failover callbacks can resubmit elsewhere."""
+        victims: List[Any] = []
         with self._lock:
             self._error = err
             while self._queue:
-                self._queue.popleft().resp._fail(err)
+                victims.append(self._queue.popleft().resp)
+            while self._control:
+                victims.append(self._control.popleft())
             self._work.notify_all()
+        for fut in victims:
+            fut._fail(err)
 
 
 def _percentile(sorted_ms: List[float], pct: float) -> float:

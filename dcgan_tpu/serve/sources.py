@@ -33,6 +33,22 @@ from typing import Callable, Dict, Optional
 import numpy as np
 
 from dcgan_tpu.serve.buckets import BucketLadder, sampler_plan
+from dcgan_tpu.utils.retry import retry_io
+
+
+def latest_finalized_step(checkpoint_dir: str) -> Optional[int]:
+    """Newest FINALIZED checkpoint step under `checkpoint_dir`, or None.
+    Integer-named directory == finalized: Orbax writes under a tmp name
+    and renames on completion, so a digit-named dir is complete by
+    contract (same screen `Checkpointer._finalized_steps` applies). IO
+    errors read as "nothing new" — the promotion watcher polls this and
+    must never crash a replica on a filesystem blip."""
+    try:
+        steps = [int(d) for d in os.listdir(checkpoint_dir)
+                 if d.isdigit()]
+    except OSError:
+        return None
+    return max(steps) if steps else None
 
 
 class CheckpointSource:
@@ -56,6 +72,7 @@ class CheckpointSource:
         self.granule = 1
         self._state = None
         self._pt = None
+        self._ckpt = None
         self._compiled: Dict[int, Callable] = {}
 
     def prepare(self) -> dict:
@@ -78,23 +95,17 @@ class CheckpointSource:
         self._pt = make_parallel_train(cfg, mesh)
         state = self._pt.init(jax.random.key(0))
         ckpt = Checkpointer(self.checkpoint_dir)
-        restored = ckpt.restore_latest(state)
+        self._ckpt = ckpt
+        # transient stat/read blips during the restore retry with backoff
+        # (the PR 4 ckpt-verify contract); a persistently broken
+        # checkpoint still fails the cold start loudly after the bounded
+        # attempts
+        restored = retry_io(lambda: ckpt.restore_latest(state),
+                            tag="serve-restore")
         if restored is None:
             raise FileNotFoundError(
                 f"no checkpoint under {self.checkpoint_dir}")
-        quant_report = None
-        if self.quantize == "int8":
-            # post-training serving rung (ISSUE 17): round-trip BOTH weight
-            # copies through int8 — sample() serves whichever the ema flag
-            # picks, and the two must not silently diverge in fidelity
-            from dcgan_tpu.serve.quantize import quantize_dequantize_int8
-
-            gen_q, quant_report = quantize_dequantize_int8(
-                restored["params"]["gen"])
-            ema_q, _ = quantize_dequantize_int8(restored["ema_gen"])
-            restored = dict(restored)
-            restored["params"] = dict(restored["params"], gen=gen_q)
-            restored["ema_gen"] = ema_q
+        restored, quant_report = self._maybe_quantize(restored)
         self._state = restored
         self.z_dim = mcfg.z_dim
         self.num_classes = mcfg.num_classes
@@ -118,6 +129,52 @@ class CheckpointSource:
             }
         return meta
 
+    def _maybe_quantize(self, restored):
+        """Apply the int8 serving rung (ISSUE 17) when armed: round-trip
+        BOTH weight copies through int8 — sample() serves whichever the
+        ema flag picks, and the two must not silently diverge in
+        fidelity. Returns (state, quant_report-or-None)."""
+        if self.quantize != "int8":
+            return restored, None
+        from dcgan_tpu.serve.quantize import quantize_dequantize_int8
+
+        gen_q, quant_report = quantize_dequantize_int8(
+            restored["params"]["gen"])
+        ema_q, _ = quantize_dequantize_int8(restored["ema_gen"])
+        restored = dict(restored)
+        restored["params"] = dict(restored["params"], gen=gen_q)
+        restored["ema_gen"] = ema_q
+        return restored, quant_report
+
+    def reload(self) -> dict:
+        """Re-restore the newest finalized step into the EXISTING state
+        template — same avals and shardings, so the swapped weights ride
+        the already-compiled bucket executables with zero new programs
+        (the promotion contract, ISSUE 19). Called ON the dispatch
+        thread by the promotion control op; `self._state` is only
+        replaced on success, so a failed reload leaves the replica
+        serving its old weights. Returns the refreshed metadata."""
+        import jax
+
+        restored = retry_io(
+            lambda: self._ckpt.restore_latest(self._state),
+            tag="serve-restore")
+        if restored is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.checkpoint_dir}")
+        restored, quant_report = self._maybe_quantize(restored)
+        self._state = restored
+        meta = {"source": "checkpoint",
+                "step": int(jax.device_get(restored["step"])),
+                "weights": "ema" if self.use_ema else "live"}
+        if quant_report is not None:
+            meta["quantize"] = quant_report
+        return meta
+
+    def latest_step_on_disk(self) -> Optional[int]:
+        """Promotion-watcher probe: newest finalized step, or None."""
+        return latest_finalized_step(self.checkpoint_dir)
+
     def bucket_plan(self, ladder: BucketLadder):
         return sampler_plan(self._pt.sample, ladder, self.z_dim,
                             state=self._state,
@@ -125,6 +182,10 @@ class CheckpointSource:
 
     def bind(self, compiled: Dict[int, Callable]) -> None:
         self._compiled = compiled
+
+    def compiled_buckets(self):
+        """Ascending bound bucket rungs (the promotion re-prime list)."""
+        return tuple(sorted(self._compiled))
 
     def sample(self, bucket: int, z: np.ndarray,
                labels: Optional[np.ndarray] = None) -> np.ndarray:
@@ -186,6 +247,13 @@ class ArtifactSource:
 
     def bind(self, compiled: Dict[int, Callable]) -> None:
         self._compiled = compiled
+
+    def compiled_buckets(self):
+        return tuple(sorted(self._compiled))
+
+    # no reload(): an artifact's weights are baked into the StableHLO
+    # bytes — promotion needs a checkpoint source; the worker fails the
+    # ticket (without poisoning the replica) when reload is absent
 
     def sample(self, bucket: int, z: np.ndarray,
                labels: Optional[np.ndarray] = None) -> np.ndarray:

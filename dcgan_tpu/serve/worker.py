@@ -20,11 +20,26 @@ Lifecycle owned here:
 - warm serving: `server._next_batch()` -> assemble z/labels -> bucketed
   dispatch -> split images back per request, resolving Responses with
   latency accounting;
+- weight promotion (ISSUE 19): a PromotionTicket control op popped from
+  the batcher IS the drain barrier — the loop is sequential, so the
+  in-flight dispatch has fully resolved before the swap. `_promote`
+  reloads the newest finalized step into the existing state template
+  (same avals/shardings — no new programs), re-primes every rung with a
+  throwaway dispatch (the PR 14 prime() trick re-links the swapped
+  weights through every cached executable), and resumes; the compile
+  cache monitor's request delta proves zero recompiles across the swap.
 - drain: once the server stops intake, the loop keeps flushing until the
   queue is empty (FIFO, same batching rules), then exits cleanly.
 
 A failure anywhere fails the in-flight requests and poisons the server —
-never a silent half-service.
+never a silent half-service. (Exception: a reload that fails BEFORE the
+state swap fails only its ticket — the old weights are intact, so the
+replica keeps serving them; the fleet surfaces the error.)
+
+Chaos hooks (testing/chaos.py, fleet drills): the per-dispatch counter
+feeds `should_kill_replica` / `maybe_replica_hang` /
+`maybe_replica_slow_beat`, so a FaultPlan can crash, wedge, or
+heartbeat-mute exactly one replica at its n-th dispatch.
 """
 
 from __future__ import annotations
@@ -35,14 +50,20 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from dcgan_tpu.serve.server import PromotionTicket, ServeError
+from dcgan_tpu.testing import chaos
+
 
 class ServeWorker:
     """Single dispatch thread bound to one SamplerServer."""
 
     def __init__(self, server):
         self._server = server
+        self._dispatch_index = 0   # 1-based, bumped per request batch
+        name = "dcgan-serve-dispatch" if server.replica_index == 0 \
+            else f"dcgan-serve-dispatch-{server.replica_index}"
         self._thread = threading.Thread(
-            target=self._run, name="dcgan-serve-dispatch", daemon=True)
+            target=self._run, name=name, daemon=True)
 
     def start(self) -> None:
         self._thread.start()
@@ -74,9 +95,29 @@ class ServeWorker:
                 batch = s._next_batch()
                 if batch is None:
                     return
+                if isinstance(batch, PromotionTicket):
+                    try:
+                        self._promote(batch)
+                    except BaseException as e:  # noqa: BLE001
+                        batch._fail(e)
+                        s._fail_all(e)
+                        return
+                    continue
                 spans, total = batch
+                self._dispatch_index += 1
+                idx = self._dispatch_index
                 try:
+                    mute = chaos.maybe_replica_slow_beat(
+                        s.replica_index, idx)
+                    if mute:
+                        s._mute_beats(mute)
+                    chaos.maybe_replica_hang(s.replica_index, idx)
+                    if chaos.should_kill_replica(s.replica_index, idx):
+                        raise ServeError(
+                            f"chaos: replica {s.replica_index} killed "
+                            f"before dispatch {idx}")
                     self._dispatch(spans, total)
+                    s._bump_beat()
                 except BaseException as e:  # noqa: BLE001
                     for p, _ in spans:
                         p.resp._fail(e)
@@ -120,6 +161,51 @@ class ServeWorker:
         }
         if s._monitor is not None:
             s._cache_post_warmup = s._monitor.counters()
+
+    def _promote(self, ticket: PromotionTicket) -> None:
+        """Hot-swap weights to the newest finalized checkpoint step.
+        Runs ON the dispatch thread, after the in-flight batch resolved
+        (the drain barrier). A reload failure BEFORE the swap fails only
+        the ticket — old weights intact, the replica keeps serving; a
+        re-prime failure raises (caller poisons the server: the swapped
+        state could not dispatch)."""
+        s = self._server
+        reload_fn = getattr(s.source, "reload", None)
+        if reload_fn is None:
+            ticket._fail(ServeError(
+                f"{type(s.source).__name__} does not support weight "
+                "promotion (no reload())"))
+            return
+        base = s._monitor.counters()["requests"] \
+            if s._monitor is not None else None
+        t0 = time.perf_counter()
+        try:
+            meta = reload_fn()
+        except BaseException as e:  # noqa: BLE001 — replica survives
+            ticket._fail(e)
+            return
+        # re-prime every rung: the first execution of a cached program
+        # with the swapped host-built args re-links the input-resharding
+        # transfer — a throwaway dispatch per bucket keeps the
+        # zero-recompile guarantee literal for the first real request
+        # after the swap
+        rungs = getattr(s.source, "compiled_buckets", tuple)()
+        for b in rungs:
+            z0 = np.zeros((b, s.source.z_dim), np.float32)
+            lbl0 = np.zeros((b,), np.int32) \
+                if s.source.num_classes else None
+            s.source.sample(b, z0, lbl0)
+        swap_ms = (time.perf_counter() - t0) * 1e3
+        delta = (s._monitor.counters()["requests"] - base) \
+            if base is not None else None
+        s.meta.update(meta)
+        s.promotions += 1
+        s.promote_swap_ms = swap_ms
+        s._bump_beat()
+        ticket._resolve({"replica": s.replica_index,
+                         "step": meta.get("step"),
+                         "swap_ms": swap_ms,
+                         "compile_requests_delta": delta})
 
     def _dispatch(self, spans: List[Tuple], total: int) -> None:
         s = self._server

@@ -27,6 +27,11 @@ Injection points in production code:
   that never joins the next collective — the other processes block in a
   real allgather/allreduce and the hung-collective watchdog must trip on
   every process.
+- `should_kill_replica(r, n)` / `maybe_replica_hang(r, n)` /
+  `maybe_replica_slow_beat(r, n)`  serve/worker.py per-dispatch hooks for
+  the serving fleet (ISSUE 19): crash, wedge, or heartbeat-mute exactly
+  one replica at its n-th dispatch — exercises failover routing, health
+  draining, and re-admission without real device faults.
 - `poll_notice(step)`        elastic/live.py's NoticePlane: returns a
   preemption-notice verdict (NOTICE_SHRINK / NOTICE_GROW) once at
   `preempt_notice_at_step` / `grow_notice_at_step` — the deterministic
@@ -88,6 +93,28 @@ class FaultPlan:
     grow_notice_at_step: int = 0     # >0: raise a capacity-restored notice
                                      # (live mesh GROW-back) at that step
                                      # boundary (once)
+    # serving-fleet faults (ISSUE 19): target ONE replica of an
+    # in-process ServeFleet. `fault_replica` names the replica index the
+    # replica_* fields apply to (arming comes from the *_at_dispatch
+    # fields being >0, so replica 0 is targetable); dispatch indices are
+    # 1-based counts of that replica's device dispatches.
+    fault_replica: int = 0           # replica index the replica_* faults
+                                     # target
+    replica_kill_at_dispatch: int = 0   # >0: the replica's worker raises
+                                        # before its n-th dispatch — a
+                                        # replica crash mid-trace
+    replica_hang_at_dispatch: int = 0   # >0: the replica's worker sleeps
+                                        # hang_secs before its n-th
+                                        # dispatch — a wedged device that
+                                        # stops heartbeating
+    replica_slow_beat_at_dispatch: int = 0  # >0: suppress the replica's
+                                            # heartbeat for slow_beat_secs
+                                            # starting at its n-th
+                                            # dispatch — still serving,
+                                            # but looks dead to the
+                                            # router's health monitor
+    slow_beat_secs: float = 2.0      # how long replica_slow_beat mutes
+                                     # the heartbeat
     _fired: Set[str] = dataclasses.field(default_factory=set)
 
     def fire_once(self, name: str) -> bool:
@@ -203,6 +230,52 @@ def maybe_hang(step: int) -> None:
         print(f"[dcgan_tpu] chaos: hanging process for {plan.hang_secs:.0f}s "
               f"at step {step}", flush=True)
         time.sleep(plan.hang_secs)
+
+
+def _replica_armed(plan: Optional[FaultPlan], replica: int,
+                   field: str, dispatch_index: int) -> bool:
+    """Shared predicate for the fleet hooks: the plan targets `replica`
+    and the named *_at_dispatch field matches this 1-based dispatch."""
+    if not plan or plan.fault_replica != replica:
+        return False
+    at = getattr(plan, field)
+    return bool(at and dispatch_index >= at and plan.fire_once(field))
+
+
+def should_kill_replica(replica: int, dispatch_index: int) -> bool:
+    """True once when replica `replica` reaches its
+    `replica_kill_at_dispatch`-th dispatch (1-based) — the worker raises
+    and the replica poisons, exactly like a device crash mid-trace."""
+    return _replica_armed(active_plan(), replica,
+                          "replica_kill_at_dispatch", dispatch_index)
+
+
+def maybe_replica_hang(replica: int, dispatch_index: int) -> None:
+    """Sleep `hang_secs` once at replica `replica`'s
+    `replica_hang_at_dispatch`-th dispatch: the worker wedges on its own
+    dispatch thread, heartbeats stop, and the router's health monitor
+    must drain the replica and failover its queue."""
+    import time
+
+    plan = active_plan()
+    if _replica_armed(plan, replica, "replica_hang_at_dispatch",
+                      dispatch_index):
+        print(f"[dcgan_tpu] chaos: hanging replica {replica} for "
+              f"{plan.hang_secs:.0f}s at dispatch {dispatch_index}",
+              flush=True)
+        time.sleep(plan.hang_secs)
+
+
+def maybe_replica_slow_beat(replica: int, dispatch_index: int) -> float:
+    """Seconds to suppress replica `replica`'s heartbeat, or 0.0. Fires
+    once at `replica_slow_beat_at_dispatch`: the replica keeps serving
+    but looks dead to the router until `slow_beat_secs` elapse — the
+    false-positive/re-admission path of the health monitor."""
+    plan = active_plan()
+    if _replica_armed(plan, replica, "replica_slow_beat_at_dispatch",
+                      dispatch_index):
+        return float(plan.slow_beat_secs)
+    return 0.0
 
 
 #: poll_notice verdicts — match elastic/live.py's wire encoding (0 = no

@@ -146,4 +146,18 @@ EVENT_KEYS: Dict[str, str] = {
     "serve/cold_start_ms": "serve entrypoint",
     "serve/compile_ms/*": "serve entrypoint",
     "serve/recompiles_after_warmup": "serve entrypoint (compile cache on)",
+
+    # -- serving fleet (ISSUE 19, serve/fleet.py + router.py): the drop
+    #    split makes fleet shedding attributable (overload = deliberate
+    #    backpressure, failover = no healthy peer could absorb), and the
+    #    fleet_* / promotion keys ride only the fleet-mode report row.
+    #    DCG004 lints serve/fleet.py and serve/router.py against this
+    #    inventory too. -------------------------------------------------
+    "serve/dropped_overload": "serve entrypoint",
+    "serve/dropped_failover": "serve entrypoint (--fleet)",
+    "serve/fleet_replicas": "serve entrypoint (--fleet)",
+    "serve/fleet_unhealthy": "serve entrypoint (--fleet)",
+    "serve/fleet_failovers": "serve entrypoint (--fleet)",
+    "serve/promotions": "serve entrypoint (weight promotion)",
+    "serve/promote_swap_ms": "serve entrypoint (weight promotion)",
 }

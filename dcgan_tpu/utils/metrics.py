@@ -65,7 +65,11 @@ class CounterSnapshot:
     # the SamplerServer registers these on its own registry instance
     serve_requests: int = 0        # generation requests accepted
     serve_completed: int = 0       # requests fully resolved with images
-    serve_dropped: int = 0         # requests shed by drop-oldest
+    serve_dropped: int = 0         # requests shed, total (overload +
+                                   # failover — the two fields below)
+    serve_dropped_overload: int = 0  # shed by drop-oldest backpressure
+    serve_dropped_failover: int = 0  # abandoned during fleet failover
+                                     # (no healthy peer could absorb)
     serve_batches: int = 0         # bucketed device dispatches
     serve_queue: int = 0           # requests pending on the serve queue
 

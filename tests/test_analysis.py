@@ -685,13 +685,68 @@ class TestTripwire:
         assert len(results) == 1
 
     def test_scope_restores_previous_owner(self):
+        me = threading.current_thread()
         with tripwire.dispatch_scope():
-            inner_owner = tripwire._dispatch_thread
+            assert me in tripwire.dispatch_owners()
             with tripwire.dispatch_scope():
-                assert tripwire._dispatch_thread is threading.current_thread()
-            assert tripwire._dispatch_thread is inner_owner
+                # re-entrant: still exactly one membership for this thread
+                assert me in tripwire.dispatch_owners()
+            # the inner exit must not evict the outer scope's ownership
+            assert me in tripwire.dispatch_owners()
         # conftest installs but no scope is active between tests
-        assert tripwire._dispatch_thread is None
+        assert me not in tripwire.dispatch_owners()
+
+    def test_concurrent_replica_scopes_are_independent_owners(self):
+        """The serving-fleet shape (ISSUE 19): N dispatch threads each
+        inside their own dispatch_scope must all pass the check
+        concurrently — one replica entering its scope must never evict
+        another's ownership — while an unscoped bystander thread still
+        trips."""
+        from dcgan_tpu.train import coordination
+
+        n = 3
+        entered = threading.Barrier(n + 1)
+        release = threading.Event()
+        errs, oks = [], []
+
+        def replica(i):
+            with tripwire.dispatch_scope():
+                entered.wait(timeout=10)
+                release.wait(timeout=10)
+                try:
+                    coordination.fleet_health_gather(
+                        np.zeros(len(coordination.HEALTH_FIELDS),
+                                 np.float32))
+                    oks.append(i)
+                except tripwire.ThreadDisciplineError as e:
+                    errs.append(e)
+
+        threads = [threading.Thread(target=replica, args=(i,),
+                                    name=f"replica-{i}")
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        entered.wait(timeout=10)   # all three scopes active at once
+        assert len(tripwire.dispatch_owners()) >= n
+
+        def bystander():
+            try:
+                coordination.fleet_health_gather(
+                    np.zeros(len(coordination.HEALTH_FIELDS), np.float32))
+                oks.append("bystander")
+            except tripwire.ThreadDisciplineError as e:
+                errs.append(e)
+
+        b = threading.Thread(target=bystander, name="bystander")
+        b.start()
+        b.join(timeout=10)
+        release.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert sorted(i for i in oks if i != "bystander") == list(range(n))
+        assert "bystander" not in oks
+        assert len(errs) == 1 and "dispatch thread" in str(errs[0])
+        assert not tripwire.dispatch_owners()
 
     def test_wrapped_programs_keep_lower(self, monkeypatch):
         """The AOT warmup contract: wrapping pt.* must not hide .lower()."""

@@ -374,7 +374,8 @@ class TestAnalysisAllSmoke:
         # Recalibrated as the tiers grew (the semantic tier compiles
         # every dispatchable program: 70 -> 97 manifest rows across the
         # pallas/precision, progressive, and live-elastic PRs; the
-        # protocol lattice is 122 interleavings): measured ~370 s on a
+        # protocol lattice is 129 interleavings with the serving-fleet
+        # promotion-drain configs): measured ~370 s on a
         # quiet 1-core host, where the original 300 s bound — set when
         # the tier took ~65 s on 2 cores — already failed BEFORE the
         # live-elastic rows landed (339 s at that commit on the same
@@ -846,6 +847,48 @@ class TestBenchServeSmoke:
         # two tiny subprocesses (1 trainer + 1 serve under SIGTERM);
         # ~4x headroom for CI contention
         assert elapsed < 240, f"serve-drain smoke took {elapsed:.0f}s"
+
+
+@pytest.mark.chaos
+class TestFleetReplicaKillSmoke:
+    """ISSUE 19's tier-1 pin (chaos-marker pattern): the serving fleet
+    under live fire through a real `python -m dcgan_tpu.serve --fleet 3`
+    subprocess — a chaos kill of replica 1 mid-trace must become a
+    failover (ZERO failed client requests, completed == submitted), the
+    dead replica must be drained from rotation and logged, and the
+    mid-trace checkpoint injection must be hot-swapped onto EXACTLY the
+    survivors with compile_requests_delta == 0 per replica (the
+    zero-recompile promotion literal, proven by the compile-cache
+    monitor, not assumed). Inside an explicit runtime budget so the pin
+    can never quietly eat the tier."""
+
+    def test_fleet_replica_kill_within_budget(self):
+        import time
+
+        t0 = time.monotonic()
+        res = subprocess.run(
+            [sys.executable, "tools/chaos_drill.py", "--only",
+             "fleet-replica-kill"], cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=420)
+        elapsed = time.monotonic() - t0
+        lines = [json.loads(l) for l in res.stdout.splitlines()
+                 if l.startswith("{")]
+        summary = lines[-1]
+        assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-500:])
+        assert summary["scenarios"] == 1 and summary["failed"] == 0
+        row = next(p for p in lines
+                   if p.get("scenario") == "fleet-replica-kill")
+        assert row["failed"] == 0
+        assert row["completed"] == row["submitted"] > 0
+        assert row["unhealthy"] == [1]
+        assert row["promoted_replicas"] == [0, 2]
+        assert row["promoted_step"] == 2
+        assert row["compile_requests_delta"] == 0
+        # three tiny subprocesses (2 trainer runs for the checkpoint
+        # lineage + 1 fleet serve; ~21 s measured total on a quiet
+        # 1-core host); ~4x headroom for CI contention
+        assert elapsed < 240, f"fleet-replica-kill took {elapsed:.0f}s"
 
 
 @pytest.mark.slow

@@ -60,6 +60,14 @@ never silently trains garbage, never hangs.
                                                          queue drains, report
                                                          lands, clean exit 0
                                                          (ISSUE 9)
+    fleet-replica-kill    chaos kill of one of 3 serve   router drains the dead
+                          replicas mid-trace, then a     replica into failover
+                          newly finalized checkpoint     (ZERO failed client
+                          step lands on disk             requests), watcher
+                                                         hot-swaps the
+                                                         survivors to the new
+                                                         step with zero
+                                                         recompiles (ISSUE 19)
     elastic-shrink        2-proc save resumed by 1       sidecar-driven
                           proc (2 devices — same mesh,   host-staged reshard;
                           different process census)      losses + STATE_SUM
@@ -667,9 +675,160 @@ def scenario_serve_drain(root: str) -> dict:
             "unsubmitted": row["unsubmitted"], "clean_exit": True}
 
 
+def _inject_step(donor_dir: str, serve_dir: str, step: int) -> None:
+    """Deliver `step` into `serve_dir` the way a trainer would: integrity
+    sidecars first, then the step dir copied under a tmp name and RENAMED
+    in — a digit-named dir is finalized by the Orbax contract, so the
+    fleet's promotion watcher can never see a half-copied step."""
+    import shutil
+
+    integ = os.path.join(donor_dir, "integrity")
+    if os.path.isdir(integ):
+        dst = os.path.join(serve_dir, "integrity")
+        os.makedirs(dst, exist_ok=True)
+        for name in os.listdir(integ):
+            if name.startswith(f"{step}."):
+                shutil.copy2(os.path.join(integ, name),
+                             os.path.join(dst, name))
+    tmp = os.path.join(serve_dir, f"tmp.promote.{step}")
+    shutil.copytree(os.path.join(donor_dir, str(step)), tmp)
+    os.rename(tmp, os.path.join(serve_dir, str(step)))
+
+
+def scenario_fleet_replica_kill(root: str) -> dict:
+    """Serving fleet under fire (ISSUE 19): 3 replicas behind the
+    failover router; a chaos fault kills replica 1's dispatch thread
+    mid-trace, then a newly finalized checkpoint step lands on disk and
+    the promotion watcher hot-swaps the SURVIVORS' weights live. The
+    contract: zero failed client requests (the kill becomes failover,
+    the promotion a drain), the dead replica is drained from rotation
+    and logged, and every surviving replica's promotion result proves
+    compile_requests_delta == 0 — fleet weight delivery mid-trace is
+    recompile-free."""
+    import shutil
+    import signal
+    import threading
+    import time
+
+    # two checkpoint dirs from one training lineage: the fleet serves
+    # step 1; the donor's step 2 is the "newly finalized" step injected
+    # mid-trace for the watcher to promote
+    ck = os.path.join(root, "ck")
+    rc, out = _run_train(
+        dict(checkpoint_dir=ck, sample_dir=os.path.join(root, "sm"),
+             save_model_secs=1e9),
+        max_steps=1)
+    _check(rc == 0, f"checkpoint trainer failed (rc={rc}): {out[-800:]}")
+    donor = os.path.join(root, "donor")
+    shutil.copytree(ck, donor)
+    rc, out = _run_train(
+        dict(checkpoint_dir=donor, sample_dir=os.path.join(root, "sm"),
+             save_model_secs=1e9),
+        max_steps=2)  # resumes @1 -> finalizes step 2
+    _check(rc == 0, f"donor trainer failed (rc={rc}): {out[-800:]}")
+    _check(os.path.isdir(os.path.join(donor, "2")),
+           "donor run left no finalized step-2 dir")
+
+    report = os.path.join(root, "serve-report.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["DCGAN_CHAOS"] = json.dumps(
+        {"fault_replica": 1, "replica_kill_at_dispatch": 2})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dcgan_tpu.serve",
+         "--checkpoint_dir", ck, "--fleet", "3",
+         "--compile_cache_dir", os.path.join(root, "cache"),
+         "--watch_promotions", "--watch_interval_secs", "0.25",
+         "--max_batch", "8", "--max_wait_ms", "20",
+         "--demo_requests", "2000", "--demo_rps", "25",
+         "--report", report, "--platform", "cpu"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    lines = []
+    reader = threading.Thread(
+        target=lambda: [lines.append(l) for l in proc.stdout], daemon=True)
+    reader.start()
+
+    def _wait_for(token: str, secs: float) -> None:
+        deadline = time.monotonic() + secs
+        while time.monotonic() < deadline \
+                and not any(token in l for l in lines):
+            if proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        _check(any(token in l for l in lines),
+               f"never saw {token!r}: {''.join(lines)[-1200:]}")
+
+    try:
+        # 3 sequential cold starts share one compile cache; the 1-core
+        # CI host still pays replica 0's compiles in full
+        _wait_for("warm: serving", 300)
+        # phase 1: load lands, replica 1's 2nd dispatch fires the kill,
+        # the router drains it from rotation and hedges its work over
+        _wait_for("replica 1 UNHEALTHY", 60)
+        # phase 2: step 2 lands FINALIZED (sidecars first, then the
+        # digit rename) and the watcher promotes the two survivors
+        _inject_step(donor, ck, 2)
+        _wait_for("serve fleet: promoted", 120)
+        time.sleep(1.0)  # a little post-promotion load on new weights
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    reader.join(timeout=10)
+    out = "".join(lines)
+    _check(rc == 0, f"serve exited rc={rc} after SIGTERM: {out[-1200:]}")
+    _check(os.path.exists(report), "no report row written after the drain")
+    with open(report) as f:
+        row = json.load(f)
+    _check(row["interrupted"] is True,
+           f"report not marked interrupted: {row}")
+    _check(0 < row["submitted"] < 2000,
+           f"signal did not land mid-load (submitted={row['submitted']})")
+    _check(row["failed"] == 0,
+           f"{row['failed']} client request(s) FAILED — the kill leaked "
+           f"past the failover router")
+    _check(row["completed"] == row["submitted"],
+           f"in-flight requests lost: submitted {row['submitted']}, "
+           f"completed {row['completed']}")
+    _check(row["serve/dropped"] == 0,
+           f"fleet dropped requests: {row['serve/dropped']}")
+    fl = row["fleet"]
+    _check(fl["replicas"] == 3, f"wrong fleet size in report: {fl}")
+    unhealthy = {i for i, _ in fl["unhealthy"]}
+    _check(1 in unhealthy,
+           f"killed replica missing from unhealthy events: "
+           f"{fl['unhealthy']}")
+    # the chaos kill surfaces exactly once, as the DEAD replica's stop
+    # error (stop() re-raises the worker's failure; fleet.stop collects)
+    _check(all(i == 1 for i, _ in fl["stop_errors"]),
+           f"a SURVIVOR failed to stop cleanly: {fl['stop_errors']}")
+    _check(any("chaos: replica 1 killed" in err
+               for _, err in fl["stop_errors"]),
+           f"chaos kill never fired (stop_errors={fl['stop_errors']}, "
+           f"unhealthy={fl['unhealthy']})")
+    _check(fl["promotions"], "watcher never promoted the injected step")
+    last = fl["promotions"][-1]
+    _check({r.get("replica") for r in last} == {0, 2},
+           f"promotion did not target exactly the survivors: {last}")
+    _check(all("error" not in r and r["step"] == 2 for r in last),
+           f"a survivor's promotion failed or got the wrong step: {last}")
+    _check(all(r.get("compile_requests_delta") == 0 for r in last),
+           f"promotion compiled something: {last}")
+    _check(row["serve/recompiles_after_warmup"] == 0,
+           f"post-warmup recompiles: "
+           f"{row['serve/recompiles_after_warmup']}")
+    return {"submitted": row["submitted"], "completed": row["completed"],
+            "failed": 0, "unhealthy": sorted(unhealthy),
+            "failovers": fl["failovers"],
+            "promoted_replicas": sorted(r["replica"] for r in last),
+            "promoted_step": 2, "compile_requests_delta": 0}
+
+
 SCENARIOS = {
     "nan-rollback": scenario_nan_rollback,
     "serve-drain": scenario_serve_drain,
+    "fleet-replica-kill": scenario_fleet_replica_kill,
     "thread-checks": scenario_thread_checks,
     "pipeline-rollback": scenario_pipeline_rollback,
     "zero-rollback": scenario_zero_rollback,
