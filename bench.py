@@ -12,11 +12,15 @@ north star is >=4x a single-V100 TF DCGAN-64 baseline; public single-V100
 TF DCGAN-64 trainers at batch 64 sustain roughly 2000 images/sec, which we
 adopt (documented assumption) as baseline=2000 for vs_baseline.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-(PIPELINE_GD=1 prints an extra pipelined-G/D A/B row FIRST — see
-_bench_pipeline_ab — so the headline row stays the last line; likewise
-ZERO_STAGE, PROGRESSIVE=1, and the PRECISION / PALLAS_FUSED knobs —
-see _bench_precision_ab.)
+Output contract (the driver parses the LAST stdout line): the headline
+row {"metric", "value", "unit", "vs_baseline"} is always the FINAL JSON
+line on stdout. Every A/B knob — PIPELINE_GD=1 (_bench_pipeline_ab),
+ZERO_STAGE={2,3} (_bench_zero_ab), PROGRESSIVE=1, PRECISION /
+PALLAS_FUSED (_bench_precision_ab), COMM_OVERLAP=1
+(_bench_comm_overlap_ab) — prints its extra row(s) BEFORE the headline
+row, and all non-row context goes to stderr, so adding a knob can never
+break the last-line parse. tests/test_comm_overlap.py pins the row
+order.
 """
 
 from __future__ import annotations
@@ -201,6 +205,85 @@ def _bench_zero_ab(cfg, mesh, n_chips: int, images, base) -> None:
         "state_mib_zero1_over_top": round(
             z1["peak_state_mib"] / ztop["peak_state_mib"], 3)
         if ztop["peak_state_mib"] else None,
+    }))
+
+
+def _bench_comm_overlap_ab(cfg, mesh, n_chips: int, images, base) -> None:
+    """COMM_OVERLAP=1: the collective overlap A/B row (ISSUE 20).
+
+    Measures the SAME workload per-step with `--comm_overlap off` vs
+    `bucket` (vs `prefetch` too when the ZeRO stage is 3) on the
+    shard_map backend — the backend whose hand-placed collectives the
+    knob restructures (gspmd's half is scheduler flags; its program is
+    unchanged) — at zero_stage = ZERO_STAGE when set, else 2. Each arm
+    reports ms_per_step AND its collective-census op counts from the
+    traced step program, so the row carries the acceptance contract
+    directly: the bucket arm's op count strictly below the per-leaf
+    baseline's, wall-clock alongside. Printed BEFORE the headline row
+    so the driver's last-line parse is unchanged.
+    """
+    import dataclasses
+
+    import jax
+
+    from dcgan_tpu.analysis.semantic import CENSUS_PRIMS, _walk_jaxpr
+    from dcgan_tpu.parallel import make_parallel_train
+
+    stage = max(2, int(os.environ.get("ZERO_STAGE") or 2))
+    if cfg.backend != "shard_map" and (cfg.mesh.model != 1
+                                       or cfg.mesh.spatial
+                                       or cfg.mesh.shard_opt
+                                       or cfg.grad_clip > 0):
+        print("COMM_OVERLAP=1 skipped: the A/B runs the shard_map "
+              "backend and this config does not compose with it",
+              file=sys.stderr)
+        return
+    steps = max(1, int(os.environ.get("BENCH_OVERLAP_STEPS",
+                                      min(STEPS_MEASURE, 60))))
+    windows = int(os.environ.get("BENCH_WINDOWS", 3))
+    arms = {}
+    for mode in ["off", "bucket"] + (["prefetch"] if stage == 3 else []):
+        cfg_o = dataclasses.replace(
+            cfg, backend="shard_map", comm_overlap=mode,
+            mesh=dataclasses.replace(cfg.mesh, zero_stage=stage))
+        pt_o = make_parallel_train(cfg_o, mesh)
+        st = pt_o.init(jax.random.key(0))
+        census = {}
+
+        def visit(eqn, _c=census):
+            kind = CENSUS_PRIMS.get(eqn.primitive.name)
+            if kind is not None:
+                _c[kind] = _c.get(kind, 0) + 1
+        _walk_jaxpr(jax.jit(pt_o.step).trace(
+            st, images, jax.random.fold_in(base, 0)).jaxpr.jaxpr, visit)
+
+        def run(st, step_idx, _pt=pt_o):
+            for _ in range(steps):
+                st, metrics = _pt.step(st, images,
+                                       jax.random.fold_in(base, step_idx))
+                step_idx += 1
+            return st, metrics, step_idx
+
+        st, _metrics, _idx, dt = _time_arm(run, st, 0, windows)
+        arms[mode] = {
+            "ms_per_step": round(dt / steps * 1e3, 3),
+            "images_per_sec_chip": round(
+                cfg.batch_size * steps / dt / n_chips, 1),
+            "collective_ops": dict(sorted(census.items())),
+            "collective_ops_total": sum(census.values()),
+        }
+        del st  # free the arm's state before the next arm compiles
+    arch = os.environ.get("BENCH_PRESET", "") or (
+        f"DCGAN-{cfg.model.output_size}")
+    best = arms.get("prefetch") or arms["bucket"]
+    print(json.dumps({
+        "metric": f"{arch} collective overlap A/B (shard_map, "
+                  f"zero_stage={stage}, batch {BATCH}/chip)",
+        "value": best["images_per_sec_chip"],
+        "unit": "images/sec/chip",
+        "vs_baseline": round(best["images_per_sec_chip"]
+                             / V100_TF_BASELINE_IMG_PER_SEC, 3),
+        **arms,
     }))
 
 
@@ -720,6 +803,14 @@ def main() -> None:
         # the fused-kernel / precision-ladder A/B row (ISSUE 17) — printed
         # before the headline row so the driver's last-line parse holds
         _bench_precision_ab(cfg, mesh, n_chips, images, base)
+    if os.environ.get("COMM_OVERLAP") == "1":
+        # the collective overlap A/B row (ISSUE 20) — printed before the
+        # headline row so the driver's last-line parse is unchanged
+        if mesh.shape["data"] < 2:
+            print("COMM_OVERLAP skipped: the overlap arms shard over the "
+                  "data axis, which needs size > 1", file=sys.stderr)
+        else:
+            _bench_comm_overlap_ab(cfg, mesh, n_chips, images, base)
     if os.environ.get("ZERO_STAGE") in ("2", "3"):
         # the ZeRO state-sharding A/B row (ISSUE 13) — printed before the
         # headline row so the driver's last-line parse is unchanged

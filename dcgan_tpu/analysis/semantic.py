@@ -141,7 +141,7 @@ def _require_platform() -> None:
 
 def small_config(backend: str = "gspmd", pipeline: bool = False,
                  zero: int = 1, precision: str = "",
-                 pallas_fused: bool = False):
+                 pallas_fused: bool = False, overlap: str = "off"):
     """The small CPU preset every program is lowered at: tiny dcgan16
     model, global batch 8 over the 2-way data mesh, every optional
     program's knob armed (sampler / probe / summarize / rollback with LR
@@ -150,7 +150,9 @@ def small_config(backend: str = "gspmd", pipeline: bool = False,
     exactly the canonical topology stages >= 2 need. `precision` /
     `pallas_fused` select the reduced-precision policy and the fused
     Pallas conv(+BN+act) blocks (ISSUE 17); the fused kernels lower in
-    interpreter mode on CPU so the fingerprints are device-independent."""
+    interpreter mode on CPU so the fingerprints are device-independent.
+    `overlap` selects the collective overlap plane (ISSUE 20) for the
+    `@overlap`/`@prefetch` variant rows."""
     from dcgan_tpu.config import MeshConfig, ModelConfig, TrainConfig
 
     return TrainConfig(
@@ -162,6 +164,7 @@ def small_config(backend: str = "gspmd", pipeline: bool = False,
         batch_size=8,
         backend=backend,
         precision=precision,
+        comm_overlap=overlap,
         # pipeline_gd is config-validated to steps_per_call=1; the plain
         # variant scans k=2 so the multi_step program joins the manifest
         steps_per_call=1 if pipeline else 2,
@@ -493,6 +496,80 @@ def enumerate_audits() -> Tuple[List[ProgramAudit], List[CoverageRow]]:
                     f"{backend}::{n}@zero{stage}", f, a, path=path,
                     expect_donation=_base(n) in DONATED_PROGRAMS,
                     cadence=cadence))
+
+        # Collective-overlap variants (ISSUE 20, DESIGN §6n): shard_map
+        # only — the bucket/prefetch restructuring changes the lowered
+        # program only where collectives are hand-placed (gspmd's half
+        # of the overlap plane is async-scheduler XLA flags; its
+        # constraint-hook program is unchanged and already audited by
+        # the @zero rows above). The SHRUNKEN census on the @overlap
+        # rows is the tentpole's headline proof — one collective per
+        # dtype bucket instead of one per leaf — and the @prefetch rows
+        # pin the staged-gather structure (same all-gather count as
+        # "off": the barrier chain moves gathers, it does not merge
+        # them). Donation must hold for every variant, and the coverage
+        # rows extend the DCG009 warmup-coverage check to the new
+        # plans (the zero-recompile contract under `--comm_overlap`).
+        if backend == "shard_map":
+            for o_stage, o_mode in ((2, "bucket"), (3, "bucket"),
+                                    (3, "prefetch")):
+                o_tag = "overlap" if o_mode == "bucket" else "prefetch"
+                cfg_o = small_config(backend, zero=o_stage,
+                                     overlap=o_mode)
+                pt_o = make_parallel_train(cfg_o, mesh)
+                plan_o, _bko = warmup.build_warmup_plan(
+                    cfg_o, pt_o, warmup.state_example(pt_o), sample_z=z,
+                    eval_z=z,
+                    make_backoff_pt=lambda c, _m=mesh:
+                        make_parallel_train(c, _m))
+                cfg_op = small_config(backend, pipeline=True,
+                                      zero=o_stage, overlap=o_mode)
+                pt_op = make_parallel_train(cfg_op, mesh)
+                plan_op, _bkop = warmup.build_warmup_plan(
+                    cfg_op, pt_op, warmup.state_example(pt_op),
+                    sample_z=None, eval_z=None,
+                    make_backoff_pt=lambda c, _m=mesh:
+                        make_parallel_train(c, _m))
+                coverage.append(CoverageRow(
+                    variant=f"{backend}+zero{o_stage}+{o_mode}",
+                    path=path, programs=frozenset(pt_o.programs),
+                    plan=tuple(n for n, _, _ in plan_o),
+                    must_cover=frozenset(
+                        {"train_step",
+                         f"multi_step@k{cfg_o.steps_per_call}",
+                         "sampler", "eval_losses", "summarize",
+                         "state_copy"})))
+                coverage.append(CoverageRow(
+                    variant=(f"{backend}+pipeline_gd+zero{o_stage}"
+                             f"+{o_mode}"),
+                    path=path, programs=frozenset(pt_op.programs),
+                    plan=tuple(n for n, _, _ in plan_op),
+                    must_cover=frozenset(stages)))
+                orows = [(n, f, a) for n, f, a in plan_o
+                         if _base(n) in step_bases]
+                orows += [(n, f, a) for n, f, a in plan_op
+                          if _base(n) in stages]
+                for n, f, a in orows:
+                    cadence = ""
+                    if n == "train_step":
+                        cadence = (
+                            f"every step when `--comm_overlap bucket` "
+                            f"at `--zero_stage {o_stage}` (per-leaf "
+                            "reduce-scatter/all-gather packed into ONE "
+                            "collective per dtype bucket; each bucket's "
+                            "reduce-scatter issues as its cotangents "
+                            "complete)"
+                            if o_mode == "bucket" else
+                            "every step when `--comm_overlap prefetch` "
+                            "(bucket's grad plan + layer-ahead staged "
+                            "param gathers: gather i+1 overlaps "
+                            "compute i via an optimization_barrier "
+                            "chain)")
+                    audits.append(audit_callable(
+                        f"{backend}::{n}@zero{o_stage}@{o_tag}", f, a,
+                        path=path,
+                        expect_donation=_base(n) in DONATED_PROGRAMS,
+                        cadence=cadence))
 
         # Fused-kernel / reduced-precision variants (ISSUE 17): the
         # @pallas_fused rows swap every interior conv/BN/act stack for

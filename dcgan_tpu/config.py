@@ -713,6 +713,23 @@ class TrainConfig:
                                    # per-device programs with hand-written
                                    # psum/pmean (parallel/shard_map_backend.py;
                                    # DP-only, composes with use_pallas)
+    comm_overlap: str = "off"      # collective overlap plane (ISSUE 20,
+                                   # DESIGN §6n). "off": the per-leaf ZeRO
+                                   # collectives, byte-identical to every
+                                   # prior build (parity-pinned). "bucket":
+                                   # reduce_grads/gather_updates pack leaves
+                                   # into dtype-grouped flat buffers — one
+                                   # large collective per bucket instead of
+                                   # one per leaf, bit-exact by construction.
+                                   # "prefetch" (zero_stage=3 only): bucket's
+                                   # plan PLUS gather_params restructured
+                                   # into layer-ahead staged gathers so XLA
+                                   # overlaps layer i+1's gather with layer
+                                   # i's compute
+    comm_bucket_mb: int = 4        # bucket size cap in MiB for
+                                   # comm_overlap != "off" (per dtype group;
+                                   # a single leaf above the cap gets its
+                                   # own bucket)
 
     def __post_init__(self):
         if self.precision not in ("", "f32", "bf16", "fp8"):
@@ -760,6 +777,19 @@ class TrainConfig:
                 "over each replica's gradient SHARD (the explicit reduce-"
                 "scatter hands optax local slices) — use the gspmd backend, "
                 "where the partitioner computes the true global norm")
+        if self.comm_overlap not in ("off", "bucket", "prefetch"):
+            raise ValueError(
+                f"comm_overlap must be one of 'off', 'bucket', 'prefetch', "
+                f"got {self.comm_overlap!r}")
+        if self.comm_overlap == "prefetch" and self.mesh.zero_stage != 3:
+            raise ValueError(
+                "comm_overlap='prefetch' restructures the ZeRO-3 "
+                "just-in-time param gathers — it requires "
+                f"mesh.zero_stage=3 (got {self.mesh.zero_stage}); use "
+                "comm_overlap='bucket' at lower stages")
+        if self.comm_bucket_mb <= 0:
+            raise ValueError(
+                f"comm_bucket_mb must be > 0, got {self.comm_bucket_mb}")
         if self.loss not in ("gan", "wgan-gp", "hinge"):
             raise ValueError(f"unknown loss {self.loss!r}")
         if self.update_mode not in ("sequential", "fused"):

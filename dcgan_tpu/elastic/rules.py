@@ -425,3 +425,54 @@ def validate_zero_state(state_shapes: Pytree, mesh_shape, *,
                 f"(shape {shape}) over the {data_size}-way data axis: no "
                 f"dim is divisible by {data_size}. Pad the offending dim, "
                 "shrink the data axis, or drop to zero_stage=1")
+
+
+def zero_bucket_plan(param_shapes: Pytree, mesh_shape, *,
+                     bucket_mb: int = 4) -> Tuple[Tuple[int, ...], ...]:
+    """Bucket plan for the collective overlap plane (ISSUE 20, DESIGN
+    §6n): group one net's scatter-targeted leaves (`zero_scatter_dims`
+    dim >= 0; replicated leaves stay outside every bucket) by dtype —
+    packing mixed dtypes would force a cast and break the bit-exactness
+    contract — and greedily cap each bucket at `bucket_mb` MiB of
+    full-leaf bytes. A single leaf larger than the cap gets a bucket of
+    its own. Deriving the plan HERE, from the same rule table that
+    placed the shards, is what keeps the wire layout and the stored
+    layout from ever disagreeing (the zero_scatter_dims contract).
+
+    Returns a tuple of buckets, each a tuple of indices into the
+    tree_leaves order of `param_shapes` — deterministic for a given
+    (tree, mesh, cap), so the lowered program is cache-stable."""
+    import math
+
+    import jax
+    import numpy as np
+
+    dims_tree = zero_scatter_dims(param_shapes, mesh_shape)
+    leaves = jax.tree_util.tree_leaves(param_shapes)
+    dleaves = jax.tree_util.tree_leaves(dims_tree)
+    cap = int(bucket_mb) * (1 << 20)
+    if cap <= 0:
+        raise ValueError(f"bucket_mb must be > 0, got {bucket_mb!r}")
+    plan: List[Tuple[int, ...]] = []
+    open_buckets: Dict[str, Tuple[List[int], int]] = {}
+    for i, (leaf, d) in enumerate(zip(leaves, dleaves)):
+        if d < 0:
+            continue
+        shape = tuple(int(s) for s in getattr(leaf, "shape", ()))
+        nbytes = math.prod(shape) * np.dtype(leaf.dtype).itemsize
+        dt = str(np.dtype(leaf.dtype))
+        idxs, used = open_buckets.get(dt, ([], 0))
+        if idxs and used + nbytes > cap:
+            plan.append(tuple(idxs))
+            idxs, used = [], 0
+        idxs.append(i)
+        used += nbytes
+        if used >= cap:
+            plan.append(tuple(idxs))
+            idxs, used = [], 0
+        open_buckets[dt] = (idxs, used)
+    for dt in sorted(open_buckets):
+        idxs, _ = open_buckets[dt]
+        if idxs:
+            plan.append(tuple(idxs))
+    return tuple(plan)

@@ -123,12 +123,47 @@ def make_shard_map_train(cfg: TrainConfig,
         def _map(fn, tree, net):
             return jax.tree_util.tree_map(fn, tree, dims[net])
 
-        zero_hooks = ZeroHooks(
-            reduce_grads=lambda g, net: _map(_scatter_mean, g, net),
-            gather_updates=((lambda u, net: _map(_gather, u, net))
-                            if zero == 2 else (lambda u, net: u)),
-            gather_params=((lambda p, net: _map(_gather, p, net))
-                           if zero >= 3 else (lambda p, net: p)))
+        reduce_grads = lambda g, net: _map(_scatter_mean, g, net)
+        gather_updates = ((lambda u, net: _map(_gather, u, net))
+                          if zero == 2 else (lambda u, net: u))
+        gather_params = ((lambda p, net: _map(_gather, p, net))
+                         if zero >= 3 else (lambda p, net: p))
+
+        if cfg.comm_overlap != "off":
+            # Collective overlap plane (ISSUE 20, DESIGN §6n): same math,
+            # restructured wire plan. reduce_grads/gather_updates swap
+            # their per-leaf collectives for one large collective per
+            # dtype-grouped bucket (the plan comes from the SAME rule
+            # table that placed the shards, so layouts cannot disagree);
+            # each bucket's psum_scatter depends only on its own leaves'
+            # cotangents, which is what lets the scheduler issue it while
+            # the rest of the backward is still running. Under "prefetch"
+            # (stage 3) the up-front full-tree param gather additionally
+            # becomes a layer-ahead staged walk. All arms are bit-exact
+            # vs "off" (tests/test_comm_overlap.py pins params to the
+            # last bit); the @overlap manifest rows pin the shrunken
+            # census.
+            from dcgan_tpu.parallel import comm as _comm
+
+            plans = {net: _rules.zero_bucket_plan(
+                         state_shapes["params"][net], mesh_shape,
+                         bucket_mb=cfg.comm_bucket_mb)
+                     for net in ("gen", "disc")}
+            reduce_grads = lambda g, net: _comm.bucketed_reduce(
+                g, dims[net], plans[net], axis_name=DATA_AXIS,
+                n_shards=n_shards)
+            if zero == 2:
+                gather_updates = lambda u, net: _comm.bucketed_gather(
+                    u, dims[net], plans[net], axis_name=DATA_AXIS,
+                    n_shards=n_shards)
+            if zero >= 3 and cfg.comm_overlap == "prefetch":
+                gather_params = lambda p, net: _comm.staged_gather(
+                    p, lambda nm, _p=p, _net=net: jax.tree_util.tree_map(
+                        _gather, _p[nm], dims[_net][nm]))
+
+        zero_hooks = ZeroHooks(reduce_grads=reduce_grads,
+                               gather_updates=gather_updates,
+                               gather_params=gather_params)
 
     fns = make_train_step(cfg, axis_name=DATA_AXIS,
                           # the pipelined stages' generator batches are

@@ -354,6 +354,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "stay resident sharded between steps with a just-"
                         "in-time all-gather inside each forward. Stages "
                         ">= 2 need a data axis of size > 1")
+    p.add_argument("--comm_overlap", choices=["off", "bucket", "prefetch"],
+                   default="off",
+                   help="collective overlap plane (DESIGN §6n): off = "
+                        "per-leaf ZeRO collectives (parity); bucket = pack "
+                        "leaves into dtype-grouped flat buffers, one large "
+                        "collective per bucket (bit-exact); prefetch "
+                        "(zero_stage=3 only) = bucket plus layer-ahead "
+                        "staged param gathers so gather i+1 overlaps "
+                        "compute i")
+    p.add_argument("--comm_bucket_mb", type=int, default=4,
+                   help="bucket size cap in MiB for --comm_overlap (per "
+                        "dtype group; an oversized leaf gets its own "
+                        "bucket)")
     p.add_argument("--mesh_spatial", action="store_true",
                    help="use the model axis to shard image height instead of "
                         "weights (conv halo exchange; the sequence-parallel "
@@ -436,6 +449,8 @@ _FLAG_FIELDS = {
     "mesh_spatial": ("mesh", "spatial"), "backend": ("", "backend"),
     "mesh_shard_opt": ("mesh", "shard_opt"),
     "zero_stage": ("mesh", "zero_stage"),
+    "comm_overlap": ("", "comm_overlap"),
+    "comm_bucket_mb": ("", "comm_bucket_mb"),
 }
 
 
@@ -518,6 +533,20 @@ def main(argv: Optional[List[str]] = None) -> None:
     # echo the effective config at startup, like the reference's
     # pp.pprint(FLAGS.__flags) (image_train.py:223)
     pprint.pprint(dataclasses.asdict(cfg))
+
+    if cfg.comm_overlap != "off":
+        # Arm XLA's async-collective scheduler before jax initializes its
+        # backend (TPU-only inside the helper — unknown XLA_FLAGS entries
+        # are fatal on other backends, so the helper also honors an
+        # explicit non-TPU --platform/JAX_PLATFORMS request). This is the
+        # gspmd half of the backward-overlap story (DESIGN §6n); the
+        # shard_map half is the bucketed/staged hook placement itself.
+        from dcgan_tpu.parallel.comm import maybe_apply_xla_overlap_flags
+        added = maybe_apply_xla_overlap_flags(
+            platform=args.platform or "")
+        if added:
+            print(f"[dcgan_tpu] comm_overlap={cfg.comm_overlap}: armed "
+                  f"{len(added)} async-collective XLA flags")
 
     if args.platform:
         import jax

@@ -57,6 +57,11 @@ EVENT_KEYS: Dict[str, str] = {
     "perf/device/idle_gap_ms": "profile_dir|profile_trigger",
     "perf/device/span_ms": "profile_dir|profile_trigger",
     "perf/device/step_ms": "profile_dir|profile_trigger",
+    # collective-time-hidden-behind-compute fraction (ISSUE 20): the
+    # `--comm_overlap` A/B's trace-level attribution; rides the same
+    # digest row as the other perf/device keys, so it stays gated on
+    # the capture knobs and out of default streams
+    "perf/device/overlap_frac": "profile_dir|profile_trigger",
 
     # -- recovery counters (absent until nonzero — the parity contract's
     #    "new keys only when the feature activates" clause) --------------

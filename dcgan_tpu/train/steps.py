@@ -182,6 +182,20 @@ class ZeroHooks:
         Stage 3's just-in-time all-gather (gspmd: a replication
         constraint, shard_map: explicit `lax.all_gather`); identity at
         stage 2, where params are already full between steps.
+
+    The collective overlap plane (ISSUE 20, DESIGN §6n) swaps hook
+    BODIES, never the seam: under `--comm_overlap bucket` the shard_map
+    backend's reduce_grads/gather_updates pack leaves into dtype-grouped
+    flat buffers (parallel/comm.py) so each hook issues one collective
+    per bucket instead of one per leaf — and because each bucket's
+    psum_scatter depends only on its own leaves' cotangents, the
+    scheduler issues it while the rest of the backward is still running,
+    instead of after the full walk. Under `--comm_overlap prefetch`
+    (stage 3) gather_params becomes a layer-ahead staged walk whose
+    optimization_barrier chain lets layer i+1's gather overlap layer i's
+    compute. The step bodies cannot tell: every arm is bit-exact vs
+    "off", and "off" leaves the original per-leaf bodies byte-identical
+    (parity-pinned).
     """
     reduce_grads: Callable
     gather_updates: Callable

@@ -922,6 +922,9 @@ def _train_run(cfg: TrainConfig, *, synthetic_data: bool,
                 # median execution, normalized for scanned multi-step
                 # dispatch (stage-summed under --pipeline_gd)
                 "perf/device/step_ms": step_ms,
+                # collective time hidden behind compute (ISSUE 20): the
+                # --comm_overlap A/B's trace-level attribution
+                "perf/device/overlap_frac": d["overlap_frac"],
             }
             print(f"[dcgan_tpu] trace digest (ending step {s}, "
                   f"{d['source']} track, top program {d['program']!r} "

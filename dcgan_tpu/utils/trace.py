@@ -202,6 +202,23 @@ def is_collective(name: str) -> bool:
     return any(m in low for m in _COLLECTIVE_MARKERS)
 
 
+def _intersect_total(a: List[Tuple[float, float]],
+                     b: List[Tuple[float, float]]) -> float:
+    """Total length of the intersection of two MERGED interval lists
+    (both sorted, non-overlapping — `_merge_intervals` output)."""
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
 def devstep_ms(path: str, per_exec: int = 1):
     """The device's own per-step ms from a capture (file or profile dir):
     the busiest program's median execution divided by `per_exec` (the
@@ -247,20 +264,37 @@ def digest(trace_path: str) -> dict:
       - program / program_n / program_ms_median: the busiest program (on a
         real device timeline: the train step program; callers divide its
         median by steps_per_call for a per-step devstep_ms)
+      - overlap_frac:  fraction of collective busy time that ran
+                       CONCURRENTLY with non-collective compute (merged
+                       collective intervals intersected with merged
+                       non-collective busy intervals, over the collective
+                       total; 0.0 when the capture has no collectives).
+                       THE attribution the `--comm_overlap` A/B needs
+                       (ISSUE 20): bucketing/prefetching claims to hide
+                       collective time behind compute, and this is where
+                       hidden-vs-exposed shows up on the device timeline
+                       — wall-clock alone can't separate "fewer ops" from
+                       "overlapped ops".
       - rows:          the full per-program table
     """
     programs, ops, source = select_device_tracks(load_events(trace_path))
     if not programs:
         return {"source": "none", "compute_ms": 0.0, "collective_ms": 0.0,
                 "idle_gap_ms": 0.0, "span_ms": 0.0, "program": "",
-                "program_n": 0, "program_ms_median": 0.0, "rows": []}
+                "program_n": 0, "program_ms_median": 0.0,
+                "overlap_frac": 0.0, "rows": []}
     spans = [(e["ts"], e["ts"] + e["dur"]) for e in programs]
     merged = _merge_intervals(spans)
     busy_us = sum(hi - lo for lo, hi in merged)
     span_us = merged[-1][1] - merged[0][0]
-    coll = [(e["ts"], e["ts"] + e["dur"])
-            for e in ops if is_collective(e["name"])]
-    coll_us = sum(hi - lo for lo, hi in _merge_intervals(coll))
+    coll_merged = _merge_intervals(
+        [(e["ts"], e["ts"] + e["dur"])
+         for e in ops if is_collective(e["name"])])
+    coll_us = sum(hi - lo for lo, hi in coll_merged)
+    nonc_merged = _merge_intervals(
+        [(e["ts"], e["ts"] + e["dur"])
+         for e in ops if not is_collective(e["name"])])
+    overlap_us = _intersect_total(coll_merged, nonc_merged)
     rows = program_rows(programs)
     top = rows[0]
     return {
@@ -272,5 +306,6 @@ def digest(trace_path: str) -> dict:
         "program": top["program"],
         "program_n": top["n"],
         "program_ms_median": top["ms_median"],
+        "overlap_frac": round(overlap_us / coll_us, 4) if coll_us else 0.0,
         "rows": rows,
     }

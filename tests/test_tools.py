@@ -340,7 +340,7 @@ class TestAnalysisAllSmoke:
              "--json", "--write-manifest", out_manifest,
              "--write-lock", out_lock],
             cwd=REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"),
-            capture_output=True, text=True, timeout=420)
+            capture_output=True, text=True, timeout=510)
         elapsed = time.monotonic() - t0
         assert res.returncode == 0, (res.stdout[-1500:], res.stderr[-800:])
         summary = json.loads(
@@ -373,14 +373,16 @@ class TestAnalysisAllSmoke:
         # The budget keeps the tier-1 pin from quietly eating the tier.
         # Recalibrated as the tiers grew (the semantic tier compiles
         # every dispatchable program: 70 -> 97 manifest rows across the
-        # pallas/precision, progressive, and live-elastic PRs; the
-        # protocol lattice is 129 interleavings with the serving-fleet
-        # promotion-drain configs): measured ~370 s on a
-        # quiet 1-core host, where the original 300 s bound — set when
-        # the tier took ~65 s on 2 cores — already failed BEFORE the
-        # live-elastic rows landed (339 s at that commit on the same
-        # host).
-        assert elapsed < 450, f"--all took {elapsed:.0f}s"
+        # pallas/precision, progressive, and live-elastic PRs, then
+        # 97 -> 124 with the collective-overlap variants; the protocol
+        # lattice is 129 interleavings with the serving-fleet
+        # promotion-drain configs): measured ~380 s quiet / 444 s under
+        # contention on a 1-core host at 124 rows, where ~370 s quiet
+        # was the 97-row measurement and the original 300 s bound — set
+        # when the tier took ~65 s on 2 cores — already failed BEFORE
+        # the live-elastic rows landed (339 s at that commit on the
+        # same host).
+        assert elapsed < 530, f"--all took {elapsed:.0f}s"
 
 
 class TestProtocolAnalysisSmoke:

@@ -178,6 +178,29 @@ class TestSyntheticTraces:
         assert d["collective_ms"] == pytest.approx(0.9)
         assert d["compute_ms"] == pytest.approx(2.0)  # module track
 
+    def test_overlap_frac_attributes_hidden_collective_time(self, tmp_path):
+        """ISSUE 20's A/B attribution: collective busy time COVERED by
+        non-collective ops counts as hidden, exposed tail does not —
+        here [200, 800) of the 1000 us all-gather runs under fusion.1,
+        so 0.6 of the collective time is hidden."""
+        ev = [meta(1, "/device:TPU:0"),
+              meta(1, "XLA Modules", tid=2), meta(1, "XLA Ops", tid=3),
+              span(1, 2, "jit_step", 0, 3000),
+              span(1, 3, "fusion.1", 0, 800),
+              span(1, 3, "all-gather-start.2", 200, 1000),
+              span(1, 3, "fusion.2", 2000, 500)]
+        d = digest(write_trace(tmp_path / "t.json.gz", ev))
+        assert d["collective_ms"] == pytest.approx(1.0)
+        assert d["overlap_frac"] == pytest.approx(0.6)
+
+    def test_overlap_frac_zero_without_collectives(self, tmp_path):
+        ev = [meta(1, "/device:TPU:0"),
+              meta(1, "XLA Modules", tid=2), meta(1, "XLA Ops", tid=3),
+              span(1, 2, "jit_step", 0, 1000),
+              span(1, 3, "fusion.1", 0, 900)]
+        d = digest(write_trace(tmp_path / "t.json.gz", ev))
+        assert d["overlap_frac"] == 0.0
+
     def test_is_collective_names(self):
         assert is_collective("all-reduce.13")
         assert is_collective("ALL-GATHER-start")

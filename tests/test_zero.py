@@ -171,7 +171,11 @@ class TestDonationAudit:
 
     def test_donated_sharded_leaves_all_alias(self):
         donating = [r for r in self._zero_rows() if r.donation is not None]
-        assert len(donating) == 32  # 4 programs x 2 backoffs x 2 stages x 2
+        # 4 programs x 2 backoffs x 2 stages x 2 backends = 32, plus the
+        # collective-overlap variants (ISSUE 20): 4 donated programs x
+        # 2 backoffs x 3 arms (zero2@overlap, zero3@overlap,
+        # zero3@prefetch) = 24
+        assert len(donating) == 56
         for r in donating:
             assert r.donation["unaliased"] == [], r.name
             assert r.donation["aliased"] == r.donation["donated"] > 0, \

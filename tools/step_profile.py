@@ -47,6 +47,16 @@ analytic flops/bytes/peak_temp_mib from ops/pallas_fused.kernel_cost —
 plus a fused-conservation summary pinning the analytic count against the
 XLA-counted unfused im2col parts.
 
+Per-program rows additionally carry a `collectives` column (ISSUE 20):
+op counts by kind plus total collective bytes from the traced jaxpr's
+census walk (the same CENSUS_PRIMS mapping the semantic tier uses). The
+single-device programs honestly census zero; ZERO_STAGE={2,3} (devices
+permitting) appends census-only rows for the SHARDED shard_map step at
+that stage — {"component": "census/train_step@zero2@off", ...} vs the
+COMM_OVERLAP={bucket,prefetch} arm — so bucket coalescing is visible
+per program: the @bucket arm's op count collapses from one collective
+per leaf to one per dtype bucket while its bytes stay equal.
+
 Workload anchor: the hot loop being replaced, image_train.py:147-194.
 """
 
@@ -157,10 +167,40 @@ def main() -> None:
             dt = min(dt, time.perf_counter() - t0)
         return dt / (CALLS * SCAN) * 1e3
 
+    # --- collective census of a traced program (ISSUE 20) -----------------
+    # The same primitive mapping the semantic tier's manifest census uses
+    # (analysis/semantic.py::CENSUS_PRIMS), plus output bytes per
+    # collective eqn — op COUNT is what bucketing shrinks, BYTES is what
+    # it must conserve.
+    from dcgan_tpu.analysis.semantic import CENSUS_PRIMS, _walk_jaxpr
+
+    def _census(closed_jaxpr):
+        ops, nbytes = {}, 0
+
+        def visit(eqn):
+            nonlocal nbytes
+            kind = CENSUS_PRIMS.get(eqn.primitive.name)
+            if kind is None:
+                return
+            ops[kind] = ops.get(kind, 0) + 1
+            for ov in eqn.outvars:
+                aval = getattr(ov, "aval", None)
+                if aval is None or not hasattr(aval, "dtype"):
+                    continue
+                n = 1
+                for d in getattr(aval, "shape", ()):
+                    n *= int(d)
+                nbytes += n * np.dtype(aval.dtype).itemsize
+        _walk_jaxpr(closed_jaxpr.jaxpr, visit)
+        return {"ops": dict(sorted(ops.items())), "bytes": int(nbytes)}
+
     # --- XLA cost analysis of the single-step program (lowered up front:
-    # the donated train-step timing below consumes `state`'s buffers) ------
-    lowered = jax.jit(fns.train_step, donate_argnums=(0,)).lower(
+    # the donated train-step timing below consumes `state`'s buffers;
+    # traced first so the census walk sees the jaxpr) ----------------------
+    traced_step = jax.jit(fns.train_step, donate_argnums=(0,)).trace(
         state, images, base)
+    step_census = _census(traced_step.jaxpr)
+    lowered = traced_step.lower()
     compiled = lowered.compile()
 
     # --- per-program resident-bytes split (ISSUE 13) ----------------------
@@ -325,7 +365,8 @@ def main() -> None:
     # ~(trips-1) bodies otherwise), same scan_trips stamp on each row.
     if os.environ.get("PIPELINE_GD") == "1":
         def _stage_cost(fn, *args, donate=()):
-            low = jax.jit(fn, donate_argnums=donate).lower(*args)
+            traced = jax.jit(fn, donate_argnums=donate).trace(*args)
+            low = traced.lower()
             c = low.compile()
             ca = c.cost_analysis()
             ca = ca[0] if isinstance(ca, (list, tuple)) else ca
@@ -334,7 +375,8 @@ def main() -> None:
                                None)
             except Exception:
                 peak = None
-            return ca.get("flops"), ca.get("bytes accessed"), peak, low
+            return (ca.get("flops"), ca.get("bytes accessed"), peak, low,
+                    _census(traced.jaxpr))
 
         stage_fns = cost_fns if scan_trips else fns
         fakes = jnp.zeros((cfg.n_critic, BATCH, size, size,
@@ -356,14 +398,15 @@ def main() -> None:
         try:
             for name, (fn, donate, grads_tree, *args) in stage_args.items():
                 try:
-                    s_flops, s_bytes, s_peak, s_low = _stage_cost(
-                        fn, *args, donate=donate)
+                    s_flops, s_bytes, s_peak, s_low, s_census = \
+                        _stage_cost(fn, *args, donate=donate)
                 except Exception as e:  # platform may not expose it
                     print(f"{name} cost_analysis unavailable: {e}",
                           file=sys.stderr)
                     continue
                 row = {"component": f"stage/{name}", "flops": s_flops,
-                       "bytes_accessed": s_bytes}
+                       "bytes_accessed": s_bytes,
+                       "collectives": s_census}
                 if donate:
                     row.update(_resident_split(s_low,
                                                _grads_mib(grads_tree)))
@@ -381,6 +424,42 @@ def main() -> None:
         finally:
             if scan_trips:
                 lax.scan = orig_scan
+
+    # --- sharded-program census rows (ISSUE 20, ZERO_STAGE={2,3}) ---------
+    # make_train_step's single-device program censuses zero collectives by
+    # construction, so bucket coalescing can't show up in the rows above.
+    # These rows trace (never compile) the SHARDED shard_map step at the
+    # requested stage, off vs the COMM_OVERLAP arm, purely for the census:
+    # the arm's op count collapses to one collective per dtype bucket
+    # while its bytes stay conserved.
+    zero_env = int(os.environ.get("ZERO_STAGE", "0") or 0)
+    if zero_env >= 2:
+        if len(jax.devices()) < 2:
+            print("ZERO_STAGE census rows need >= 2 devices; skipping",
+                  file=sys.stderr)
+        else:
+            from dcgan_tpu.config import MeshConfig
+            from dcgan_tpu.parallel import make_mesh, make_parallel_train
+            from dcgan_tpu.train import warmup
+
+            overlap = os.environ.get("COMM_OVERLAP", "")
+            if overlap in ("", "1"):
+                overlap = "bucket"
+            mesh_cfg = MeshConfig(data=2, zero_stage=zero_env)
+            mesh = make_mesh(mesh_cfg, jax.devices()[:2])
+            for mode in ("off", overlap):
+                cfg_s = dataclasses.replace(
+                    cfg, backend="shard_map", mesh=mesh_cfg,
+                    comm_overlap=mode)
+                pt_s = make_parallel_train(cfg_s, mesh)
+                st_s = warmup.state_example(pt_s)
+                img_s = jax.ShapeDtypeStruct(
+                    (BATCH, size, size, cfg.model.c_dim), jnp.float32)
+                tr = jax.jit(pt_s.step).trace(st_s, img_s, base)
+                print(json.dumps(
+                    {"component":
+                         f"census/train_step@zero{zero_env}@{mode}",
+                     "collectives": _census(tr.jaxpr)}), flush=True)
 
     # --- forward only: G fwd + D fwd on real and fake (no grads, no Adam) --
     @jax.jit
@@ -448,7 +527,8 @@ def main() -> None:
 
     step_ms = _timed(lambda s: many_steps(s, images, keys), state)
     print(json.dumps({"component": "train_step", "ms": round(step_ms, 4),
-                      "images_per_sec": round(BATCH / step_ms * 1e3, 1)}),
+                      "images_per_sec": round(BATCH / step_ms * 1e3, 1),
+                      "collectives": step_census}),
           flush=True)
 
     flops = bytes_accessed = None
